@@ -1,0 +1,19 @@
+from .abstract_accelerator import Accelerator
+from .real_accelerator import (
+    CpuAccelerator,
+    GpuAccelerator,
+    TpuAccelerator,
+    get_accelerator,
+    reset_accelerator,
+    set_accelerator,
+)
+
+__all__ = [
+    "Accelerator",
+    "CpuAccelerator",
+    "GpuAccelerator",
+    "TpuAccelerator",
+    "get_accelerator",
+    "set_accelerator",
+    "reset_accelerator",
+]

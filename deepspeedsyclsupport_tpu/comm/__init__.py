@@ -1,0 +1,29 @@
+from .comm import (
+    all_gather,
+    all_reduce,
+    all_to_all,
+    axis_index,
+    axis_size,
+    barrier,
+    broadcast,
+    get_device_count,
+    get_local_rank,
+    get_rank,
+    get_world_size,
+    init_distributed,
+    is_initialized,
+    pmean,
+    ppermute,
+    reduce_scatter,
+    send_recv_next,
+    send_recv_prev,
+)
+from .comms_logging import CommsLogger, comms_logger, get_comms_logger
+from .topology import (
+    AXIS_ORDER,
+    MeshTopology,
+    build_topology,
+    get_world_topology,
+    reset_world_topology,
+    set_world_topology,
+)

@@ -1,0 +1,85 @@
+"""Communication op logging.
+
+Analog of the reference's comms logger (``deepspeed/utils/comms_logging.py`` +
+``@timed_op`` wrapper at ``comm/comm.py:101-142``): per-op counts, message sizes, and a
+``log_summary()`` table.
+
+Timing semantics differ by construction: the reference times every eager NCCL call;
+under XLA, collectives are fused into one compiled program, so per-op wall-clock is only
+visible to the profiler. What we can and do record losslessly at *trace* time is the op
+mix — name, mesh axis, message bytes, call count — which is what the reference's summary
+table mostly shows. Wall-clock per collective comes from ``jax.profiler`` traces
+(see ``profiling/``).
+"""
+import threading
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class _OpRecord:
+    count: int = 0
+    total_bytes: int = 0
+    shapes: List[tuple] = field(default_factory=list)
+
+
+class CommsLogger:
+    """Trace-time collective op recorder (reference: ``utils/comms_logging.py``)."""
+
+    def __init__(self, enabled: bool = False, verbose: bool = False, debug: bool = False):
+        self.enabled = enabled
+        self.verbose = verbose
+        self.debug = debug
+        self._lock = threading.Lock()
+        self._records: Dict[str, _OpRecord] = defaultdict(_OpRecord)
+
+    def configure(self, enabled: Optional[bool] = None, verbose: Optional[bool] = None):
+        if enabled is not None:
+            self.enabled = enabled
+        if verbose is not None:
+            self.verbose = verbose
+
+    def append(self, op_name: str, axis_name, nbytes: int, shape: tuple):
+        if not self.enabled:
+            return
+        key = f"{op_name}[{axis_name}]"
+        with self._lock:
+            rec = self._records[key]
+            rec.count += 1
+            rec.total_bytes += nbytes
+            if self.debug:
+                rec.shapes.append(shape)
+        if self.verbose:
+            from ..utils.logging import logger
+
+            logger.info("comm op: %s | bytes: %d | shape: %s", key, nbytes, shape)
+
+    def log_summary(self) -> str:
+        """Render a summary table (reference: ``log_summary`` via ``comm/comm.py:422``)."""
+        lines = [f"{'op':<40}{'count':>10}{'total MB':>14}"]
+        with self._lock:
+            for key in sorted(self._records):
+                rec = self._records[key]
+                lines.append(f"{key:<40}{rec.count:>10}{rec.total_bytes / 2**20:>14.2f}")
+        table = "\n".join(lines)
+        from ..utils.logging import logger
+
+        logger.info("\n%s", table)
+        return table
+
+    def reset(self):
+        with self._lock:
+            self._records.clear()
+
+    def snapshot(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {k: {"count": v.count, "total_bytes": v.total_bytes}
+                    for k, v in self._records.items()}
+
+
+comms_logger = CommsLogger()
+
+
+def get_comms_logger() -> CommsLogger:
+    return comms_logger

@@ -1,0 +1,188 @@
+"""Named-axis device mesh topology.
+
+TPU-native analog of the reference's process-group topology layer:
+
+* ``deepspeed/utils/groups.py:51-560`` — lazy creation of data/model/expert/sequence
+  parallel process groups with accessors (``_get_data_parallel_group`` etc.).
+* ``deepspeed/runtime/pipe/topology.py:12,251`` — ``ProcessTopology`` /
+  ``PipelineParallelGrid`` mapping ranks onto (pipe, data, model) axes.
+
+Where the reference materializes NCCL/oneCCL communicators per group, the TPU design
+materializes **one** :class:`jax.sharding.Mesh` with named axes; XLA derives every
+"group" from sharding specs, and collectives ride ICI/DCN automatically. The axis order
+encodes physical locality: the innermost (fastest-varying) axes land on adjacent chips
+(ICI neighbors), the outermost on DCN.  Tensor parallelism is the most
+latency-sensitive, so ``model`` is innermost; ``pipe`` tolerates DCN, so it is outermost.
+
+Axis vocabulary (superset of the reference's pipe/data/model):
+
+===========  =====================================================================
+``data``     pure data parallel (gradient psum)                 [engine.py:1903]
+``fsdp``     ZeRO parameter/grad/optimizer sharding             [zero/stage*.py]
+``pipe``     pipeline stages                                    [runtime/pipe/]
+``expert``   expert parallel for MoE                            [moe/sharded_moe.py]
+``seq``      Ulysses sequence parallel                          [sequence/layer.py]
+``model``    tensor parallel (Megatron-style mpu)               [module_inject/auto_tp.py]
+===========  =====================================================================
+"""
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# Outer-to-inner physical layout order (outermost = DCN-tolerant).
+AXIS_ORDER: Tuple[str, ...] = ("pipe", "data", "fsdp", "expert", "seq", "model")
+
+_WORLD_TOPOLOGY: Optional["MeshTopology"] = None
+
+
+@dataclass
+class MeshTopology:
+    """One named mesh carrying every parallelism axis.
+
+    Analog of ``PipelineParallelGrid`` (reference ``topology.py:251``) generalized to
+    all six axes. ``axis_sizes`` maps axis name → size; any axis may be absent
+    (size 1). At most one axis may be ``-1`` meaning "consume remaining devices".
+    """
+
+    axis_sizes: Dict[str, int]
+    devices: Optional[Sequence[Any]] = None
+    _mesh: Any = field(default=None, repr=False)
+
+    def __post_init__(self):
+        from jax.sharding import Mesh
+
+        if self.devices is not None:
+            devs = list(self.devices)
+        else:
+            # Route through the accelerator seam (SURVEY.md §1 invariant: every
+            # device touch goes through get_accelerator()) so DSTPU_ACCELERATOR=cpu
+            # builds the mesh from virtual host devices even when a real TPU is the
+            # default jax backend.
+            from ..accelerator import get_accelerator
+
+            devs = get_accelerator().devices()
+        n = len(devs)
+        sizes = {ax: int(self.axis_sizes.get(ax, 1)) for ax in AXIS_ORDER}
+        unknown = set(self.axis_sizes) - set(AXIS_ORDER)
+        if unknown:
+            raise ValueError(f"Unknown mesh axes {unknown}; valid: {AXIS_ORDER}")
+        wild = [ax for ax, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError("At most one axis may be -1 (auto-fill)")
+        fixed = int(np.prod([s for s in sizes.values() if s != -1]))
+        if wild:
+            if n % fixed != 0:
+                raise ValueError(
+                    f"Device count {n} not divisible by fixed axes product {fixed}")
+            sizes[wild[0]] = n // fixed
+        total = int(np.prod(list(sizes.values())))
+        if total != n:
+            raise ValueError(
+                f"Mesh axes {sizes} multiply to {total} but {n} devices are visible")
+        self.axis_sizes = sizes
+        grid = np.asarray(devs).reshape([sizes[ax] for ax in AXIS_ORDER])
+        self._mesh = Mesh(grid, AXIS_ORDER)
+
+    # ------------------------------------------------------------------ mesh access
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def __enter__(self):
+        return self._mesh.__enter__()
+
+    def __exit__(self, *a):
+        return self._mesh.__exit__(*a)
+
+    def axis_size(self, axis: str) -> int:
+        return self.axis_sizes[axis]
+
+    # Accessor parity with deepspeed/utils/groups.py ---------------------------
+    def get_data_parallel_world_size(self) -> int:
+        """DP replicas = data × fsdp (ZeRO shards are still data-parallel replicas
+        from the model's point of view, matching the reference where ZeRO partitions
+        *within* the DP group)."""
+        return self.axis_sizes["data"] * self.axis_sizes["fsdp"]
+
+    def get_model_parallel_world_size(self) -> int:
+        return self.axis_sizes["model"]
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self.axis_sizes["pipe"]
+
+    def get_expert_parallel_world_size(self) -> int:
+        return self.axis_sizes["expert"]
+
+    def get_sequence_parallel_world_size(self) -> int:
+        return self.axis_sizes["seq"]
+
+    def get_fsdp_world_size(self) -> int:
+        return self.axis_sizes["fsdp"]
+
+    def world_size(self) -> int:
+        return int(np.prod(list(self.axis_sizes.values())))
+
+    # ------------------------------------------------------------------ sharding
+    def sharding(self, *spec_axes) -> Any:
+        """NamedSharding for a PartitionSpec given per-dimension axis names.
+
+        ``topo.sharding(('data','fsdp'), None, 'model')`` shards dim0 over data+fsdp,
+        replicates dim1, shards dim2 over model.
+        """
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self._mesh, PartitionSpec(*spec_axes))
+
+    def replicated(self) -> Any:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self._mesh, PartitionSpec())
+
+    @property
+    def batch_axes(self) -> Tuple[str, ...]:
+        """Axes over which the global batch is split (data + fsdp)."""
+        return tuple(ax for ax in ("data", "fsdp") if self.axis_sizes[ax] > 1) or ("data",)
+
+    def data_sharding(self, ndim: int) -> Any:
+        """Standard input-batch sharding: dim0 over (data, fsdp), rest replicated."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self._mesh, PartitionSpec(("data", "fsdp"),
+                                                       *([None] * (ndim - 1))))
+
+
+def build_topology(dp: int = -1,
+                   fsdp: int = 1,
+                   tp: int = 1,
+                   pp: int = 1,
+                   ep: int = 1,
+                   sp: int = 1,
+                   devices: Optional[Sequence[Any]] = None) -> MeshTopology:
+    """Build and install the world topology (reference: ``groups.initialize()``,
+    ``deepspeed/utils/groups.py:51``)."""
+    topo = MeshTopology(
+        axis_sizes={"data": dp, "fsdp": fsdp, "model": tp, "pipe": pp,
+                    "expert": ep, "seq": sp},
+        devices=devices,
+    )
+    set_world_topology(topo)
+    return topo
+
+
+def set_world_topology(topo: MeshTopology) -> None:
+    global _WORLD_TOPOLOGY
+    _WORLD_TOPOLOGY = topo
+
+
+def get_world_topology() -> MeshTopology:
+    global _WORLD_TOPOLOGY
+    if _WORLD_TOPOLOGY is None:
+        _WORLD_TOPOLOGY = build_topology()
+    return _WORLD_TOPOLOGY
+
+
+def reset_world_topology() -> None:
+    global _WORLD_TOPOLOGY
+    _WORLD_TOPOLOGY = None

@@ -1,0 +1,2 @@
+from .logging import log_dist, logger
+from .timer import SynchronizedWallClockTimer, ThroughputTimer

@@ -1,0 +1,46 @@
+"""Logging utilities (analog of ``deepspeed/utils/logging.py``: ``logger`` +
+rank-filtered ``log_dist``)."""
+import logging
+import os
+import sys
+from typing import Iterable, Optional
+
+LOG_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s"
+
+
+def _create_logger(name: str = "dstpu", level: Optional[int] = None) -> logging.Logger:
+    lg = logging.getLogger(name)
+    if lg.handlers:
+        return lg
+    level = level if level is not None else _env_level()
+    lg.setLevel(level)
+    lg.propagate = False
+    handler = logging.StreamHandler(stream=sys.stdout)
+    handler.setFormatter(logging.Formatter(LOG_FORMAT))
+    lg.addHandler(handler)
+    return lg
+
+
+def _env_level() -> int:
+    return getattr(logging, os.environ.get("DSTPU_LOG_LEVEL", "INFO").upper(), logging.INFO)
+
+
+logger = _create_logger()
+
+
+def log_dist(message: str, ranks: Optional[Iterable[int]] = None,
+             level: int = logging.INFO) -> None:
+    """Log only on the given process ranks (reference: ``utils/logging.py`` log_dist).
+
+    ``ranks=None`` or containing -1 logs everywhere; default logs on rank 0 only.
+    """
+    import jax
+
+    my_rank = jax.process_index()
+    ranks = list(ranks) if ranks is not None else [0]
+    if -1 in ranks or my_rank in ranks:
+        logger.log(level, "[Rank %d] %s", my_rank, message)
+
+
+def should_log_le(max_log_level_str: str) -> bool:
+    return logger.getEffectiveLevel() <= getattr(logging, max_log_level_str.upper())

@@ -1,0 +1,35 @@
+"""Test bootstrap: simulate an 8-device TPU-like mesh on host CPU.
+
+Analog of the reference's distributed test harness (``tests/unit/common.py:105`` —
+``DistributedTest`` spawning N real processes per test). Under JAX we instead ask XLA
+for N virtual host devices in ONE process, which exercises the identical SPMD programs
+(same collectives, same shardings) without hardware — the approach SURVEY.md §4 calls
+the "fake backend".
+
+Must run before any jax import, hence module-level os.environ mutation in conftest.
+"""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_state():
+    """Fresh topology/accelerator registry per test."""
+    yield
+    from deepspeedsyclsupport_tpu.comm.topology import reset_world_topology
+
+    reset_world_topology()
+
+
+@pytest.fixture
+def mesh8():
+    from deepspeedsyclsupport_tpu.comm.topology import build_topology
+
+    return build_topology(dp=-1)

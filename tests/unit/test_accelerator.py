@@ -1,0 +1,49 @@
+"""Accelerator abstraction conformance (reference: ``tests/accelerator/``)."""
+import jax
+import pytest
+
+from deepspeedsyclsupport_tpu.accelerator import (
+    CpuAccelerator,
+    get_accelerator,
+    reset_accelerator,
+    set_accelerator,
+)
+
+
+def test_autodetect_cpu_sim():
+    reset_accelerator()
+    acc = get_accelerator()
+    assert acc.name() == "cpu"
+    assert acc.is_available()
+    assert acc.device_count() == 8  # conftest forces 8 virtual devices
+
+
+def test_set_accelerator_roundtrip():
+    acc = CpuAccelerator()
+    set_accelerator(acc)
+    assert get_accelerator() is acc
+    reset_accelerator()
+
+
+def test_dtype_support():
+    acc = get_accelerator()
+    assert acc.is_bf16_supported()
+    assert acc.preferred_dtype() == jax.numpy.bfloat16
+
+
+def test_synchronize_and_rng():
+    acc = get_accelerator()
+    key = acc.default_rng(0)
+    x = jax.random.normal(key, (8, 8))
+    acc.synchronize(x)
+    assert x.shape == (8, 8)
+
+
+def test_env_override_rejects_bogus(monkeypatch):
+    monkeypatch.setenv("DSTPU_ACCELERATOR", "quantum")
+    reset_accelerator()
+    with pytest.raises(ValueError):
+        get_accelerator()
+    monkeypatch.setenv("DSTPU_ACCELERATOR", "cpu")
+    reset_accelerator()
+    assert get_accelerator().name() == "cpu"

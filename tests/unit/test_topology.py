@@ -1,0 +1,66 @@
+"""Mesh topology tests (reference: ``tests/unit/model_parallelism``, topology parts of
+``tests/unit/pipe``)."""
+import numpy as np
+import pytest
+
+from deepspeedsyclsupport_tpu.comm.topology import (
+    AXIS_ORDER,
+    MeshTopology,
+    build_topology,
+    get_world_topology,
+)
+
+
+def test_default_all_data():
+    topo = build_topology(dp=-1)
+    assert topo.axis_sizes["data"] == 8
+    assert topo.world_size() == 8
+    assert topo.get_data_parallel_world_size() == 8
+
+
+def test_mixed_axes():
+    topo = build_topology(dp=-1, tp=2, fsdp=2)
+    assert topo.axis_sizes == {"pipe": 1, "data": 2, "fsdp": 2, "expert": 1,
+                               "seq": 1, "model": 2}
+    assert topo.get_model_parallel_world_size() == 2
+    assert topo.get_fsdp_world_size() == 2
+    # dp×fsdp are both batch-splitting axes
+    assert topo.get_data_parallel_world_size() == 4
+
+
+def test_axis_order_model_innermost():
+    assert AXIS_ORDER[-1] == "model"
+    assert AXIS_ORDER[0] == "pipe"
+
+
+def test_invalid_sizes():
+    with pytest.raises(ValueError):
+        MeshTopology(axis_sizes={"data": 3, "model": 2})  # 6 != 8
+    with pytest.raises(ValueError):
+        MeshTopology(axis_sizes={"data": -1, "model": -1})
+    with pytest.raises(ValueError):
+        MeshTopology(axis_sizes={"bogus": 2})
+
+
+def test_sharding_spec_construction():
+    topo = build_topology(dp=-1, tp=2)
+    sh = topo.sharding(("data", "fsdp"), None, "model")
+    assert sh.mesh is not None
+    data_sh = topo.data_sharding(3)
+    assert data_sh.spec[0] == ("data", "fsdp")
+
+
+def test_world_topology_singleton():
+    topo = build_topology(dp=4, tp=2)
+    assert get_world_topology() is topo
+
+
+def test_sharded_array_placement():
+    import jax
+    import jax.numpy as jnp
+
+    topo = build_topology(dp=-1)
+    x = jnp.arange(16.0).reshape(8, 2)
+    xs = jax.device_put(x, topo.data_sharding(2))
+    assert len(xs.addressable_shards) == 8
+    np.testing.assert_allclose(np.asarray(xs), np.arange(16.0).reshape(8, 2))
