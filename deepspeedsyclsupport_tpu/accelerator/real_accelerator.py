@@ -58,6 +58,19 @@ class TpuAccelerator(_JaxAccelerator):
 class CpuAccelerator(_JaxAccelerator):
     def __init__(self):
         super().__init__("cpu")
+        import jax
+
+        # Site-level TPU plugins may force jax_platforms to a remote backend at
+        # interpreter start; a CPU accelerator must never trigger that backend's
+        # (possibly blocking) initialization when the topology asks for devices.
+        # Pinning is only possible before any backend initialized.
+        try:
+            from jax._src import xla_bridge
+
+            if not xla_bridge.backends_are_initialized():
+                jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
 
     def preferred_dtype(self):
         import jax.numpy as jnp

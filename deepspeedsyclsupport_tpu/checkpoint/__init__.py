@@ -1,0 +1,1 @@
+from .engine import load_tree, save_tree

@@ -1,0 +1,148 @@
+"""Experiment monitors.
+
+Analog of ``deepspeed/monitor/`` — ``Monitor`` ABC + TensorBoard/W&B/CSV backends
+(``monitor/{monitor,tensorboard,wandb,csv_monitor}.py``, config ``monitor/config.py``).
+Same event contract: ``write_events([(name, value, global_step), ...])``.
+"""
+import csv
+import os
+from typing import Any, List, Optional, Tuple
+
+from ..runtime.config import MonitorConfig
+from ..utils.logging import logger
+
+Event = Tuple[str, Any, int]
+
+
+class Monitor:
+    def __init__(self, config: MonitorConfig):
+        self.config = config
+        self.enabled = True
+
+    def write_events(self, events: List[Event]) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class CsvMonitor(Monitor):
+    """CSV backend (reference: ``monitor/csv_monitor.py``): one file per metric."""
+
+    def __init__(self, config: MonitorConfig):
+        super().__init__(config)
+        self.base = os.path.join(config.csv_output_path or "csv_logs",
+                                 config.csv_job_name)
+        os.makedirs(self.base, exist_ok=True)
+        self._files = {}
+
+    def _writer(self, name: str):
+        if name not in self._files:
+            path = os.path.join(self.base, name.replace("/", "_") + ".csv")
+            f = open(path, "a", newline="")
+            self._files[name] = (f, csv.writer(f))
+        return self._files[name]
+
+    def write_events(self, events: List[Event]) -> None:
+        for name, value, step in events:
+            f, w = self._writer(name)
+            w.writerow([step, float(value)])
+
+    def flush(self) -> None:
+        for f, _ in self._files.values():
+            f.flush()
+
+    def close(self) -> None:
+        for f, _ in self._files.values():
+            f.close()
+        self._files.clear()
+
+
+class TensorBoardMonitor(Monitor):
+    """TensorBoard backend (reference: ``monitor/tensorboard.py``); degrades to a
+    warning when no tensorboard writer is importable in the image."""
+
+    def __init__(self, config: MonitorConfig):
+        super().__init__(config)
+        self.writer = None
+        path = os.path.join(config.tensorboard_output_path or "tensorboard",
+                            config.tensorboard_job_name)
+        try:
+            from torch.utils.tensorboard import SummaryWriter  # type: ignore
+
+            self.writer = SummaryWriter(log_dir=path)
+        except Exception as e:  # pragma: no cover - env dependent
+            logger.warning("tensorboard unavailable (%s); events dropped", e)
+            self.enabled = False
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.writer:
+            return
+        for name, value, step in events:
+            self.writer.add_scalar(name, float(value), step)
+
+    def flush(self) -> None:
+        if self.writer:
+            self.writer.flush()
+
+    def close(self) -> None:
+        if self.writer:
+            self.writer.close()
+
+
+class WandbMonitor(Monitor):
+    """Weights & Biases backend (reference: ``monitor/wandb.py``); gated on import."""
+
+    def __init__(self, config: MonitorConfig):
+        super().__init__(config)
+        try:
+            import wandb  # type: ignore
+
+            wandb.init(project=config.wandb_project, entity=config.wandb_team,
+                       group=config.wandb_group)
+            self._wandb = wandb
+        except Exception as e:  # pragma: no cover - env dependent
+            logger.warning("wandb unavailable (%s); events dropped", e)
+            self._wandb = None
+            self.enabled = False
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self._wandb:
+            return
+        for name, value, step in events:
+            self._wandb.log({name: float(value)}, step=step)
+
+
+class MonitorMaster(Monitor):
+    """Fan-out to all enabled backends; only process rank 0 writes (reference:
+    ``monitor/monitor.py`` MonitorMaster rank gating)."""
+
+    def __init__(self, config: MonitorConfig):
+        super().__init__(config)
+        import jax
+
+        self.monitors: List[Monitor] = []
+        if jax.process_index() == 0:
+            if config.tensorboard_enabled:
+                self.monitors.append(TensorBoardMonitor(config))
+            if config.wandb_enabled:
+                self.monitors.append(WandbMonitor(config))
+            if config.csv_enabled:
+                self.monitors.append(CsvMonitor(config))
+        self.enabled = any(m.enabled for m in self.monitors)
+
+    def write_events(self, events: List[Event]) -> None:
+        for m in self.monitors:
+            if m.enabled:
+                m.write_events(events)
+
+    def flush(self) -> None:
+        for m in self.monitors:
+            m.flush()
+
+    def close(self) -> None:
+        for m in self.monitors:
+            m.close()

@@ -1,0 +1,2 @@
+from .config import DSTpuConfig
+from .engine import Engine, initialize

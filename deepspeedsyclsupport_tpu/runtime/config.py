@@ -1,0 +1,455 @@
+"""JSON config system.
+
+Analog of ``deepspeed/runtime/config.py`` (``DeepSpeedConfig``) +
+``runtime/config_utils.py`` + the per-subsystem pydantic models
+(``runtime/zero/config.py``, ``monitor/config.py``, ``comm/config.py`` …).
+
+Same surface: one JSON file or dict drives the whole engine; the batch invariant
+``train_batch_size = micro_batch_per_device × gradient_accumulation_steps ×
+dp_world_size`` is enforced/derived exactly like the reference's
+``_batch_assertion``/``_set_batch_related_parameters`` logic. Implementation is plain
+dataclasses — no pydantic dependency — because the schema is small and static.
+"""
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+from . import constants as C
+from ..utils.logging import logger
+
+AUTO = "auto"
+
+
+def _sub(d: Dict[str, Any], key: str) -> Dict[str, Any]:
+    v = d.get(key, {})
+    if v in (None, False):
+        return {}
+    if v is True:
+        return {"enabled": True}
+    if not isinstance(v, dict):
+        raise ValueError(f"config section {key!r} must be a dict, got {type(v)}")
+    return v
+
+
+@dataclass
+class OptimizerConfig:
+    """``optimizer`` section (reference: ``_configure_basic_optimizer``,
+    ``engine.py:1267`` — Adam/AdamW/Lamb/OneBitAdam/Lion via op builders; ours map
+    to optax transforms, fused by XLA)."""
+    type: str = C.OPTIMIZER_TYPE_DEFAULT
+    params: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "OptimizerConfig":
+        return cls(type=str(d.get("type", C.OPTIMIZER_TYPE_DEFAULT)).lower(),
+                   params=dict(d.get("params", {})))
+
+    @property
+    def lr(self) -> float:
+        return float(self.params.get("lr", 1e-3))
+
+
+@dataclass
+class SchedulerConfig:
+    """``scheduler`` section (reference: ``runtime/lr_schedules.py``)."""
+    type: Optional[str] = None
+    params: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SchedulerConfig":
+        return cls(type=d.get("type"), params=dict(d.get("params", {})))
+
+
+@dataclass
+class Fp16Config:
+    """``fp16`` section incl. dynamic loss scaling knobs
+    (reference: ``runtime/fp16/loss_scaler.py`` DynamicLossScaler)."""
+    enabled: bool = False
+    loss_scale: float = 0.0  # 0 → dynamic
+    initial_scale_power: int = C.INITIAL_LOSS_SCALE_POWER_DEFAULT
+    loss_scale_window: int = C.LOSS_SCALE_WINDOW_DEFAULT
+    hysteresis: int = C.HYSTERESIS_DEFAULT
+    min_loss_scale: float = C.MIN_LOSS_SCALE_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Fp16Config":
+        return cls(enabled=bool(d.get("enabled", False)),
+                   loss_scale=float(d.get("loss_scale", 0.0)),
+                   initial_scale_power=int(d.get(C.INITIAL_LOSS_SCALE_POWER,
+                                                 C.INITIAL_LOSS_SCALE_POWER_DEFAULT)),
+                   loss_scale_window=int(d.get(C.LOSS_SCALE_WINDOW,
+                                               C.LOSS_SCALE_WINDOW_DEFAULT)),
+                   hysteresis=int(d.get(C.HYSTERESIS, C.HYSTERESIS_DEFAULT)),
+                   min_loss_scale=float(d.get(C.MIN_LOSS_SCALE,
+                                              C.MIN_LOSS_SCALE_DEFAULT)))
+
+    @property
+    def dynamic(self) -> bool:
+        return self.loss_scale == 0.0
+
+    @property
+    def initial_scale(self) -> float:
+        return float(self.loss_scale) if self.loss_scale else 2.0 ** self.initial_scale_power
+
+
+@dataclass
+class Bf16Config:
+    enabled: bool = False
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Bf16Config":
+        return cls(enabled=bool(d.get("enabled", False)))
+
+
+@dataclass
+class OffloadConfig:
+    """``zero_optimization.offload_{optimizer,param}`` (reference:
+    ``runtime/zero/offload_config.py``). ``device`` 'cpu' = host RAM via
+    jax.device_put to the host backend; 'nvme' = async file swap (csrc/aio analog)."""
+    device: str = "none"  # none | cpu | nvme
+    nvme_path: Optional[str] = None
+    pin_memory: bool = True
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "OffloadConfig":
+        return cls(device=str(d.get("device", "none")),
+                   nvme_path=d.get("nvme_path"),
+                   pin_memory=bool(d.get("pin_memory", True)))
+
+    @property
+    def enabled(self) -> bool:
+        return self.device not in ("none", None)
+
+
+@dataclass
+class ZeroConfig:
+    """``zero_optimization`` section (reference: ``runtime/zero/config.py``
+    ``DeepSpeedZeroConfig``). Stages keep reference semantics:
+
+    0 → pure DP (replicated params/opt, psum grads)         [engine.py:1903]
+    1 → optimizer state sharded over fsdp axis              [stage_1_and_2.py]
+    2 → + gradient shards (reduce_scatter at boundary)      [stage_1_and_2.py:1004]
+    3 → + parameter shards (XLA all-gathers per use)        [stage3.py]
+
+    ZeRO++ knobs map to quantized-collective / hierarchical-partition analogs.
+    """
+    stage: int = C.ZERO_STAGE_DEFAULT
+    offload_optimizer: OffloadConfig = field(default_factory=OffloadConfig)
+    offload_param: OffloadConfig = field(default_factory=OffloadConfig)
+    zero_quantized_weights: bool = False    # qwZ: int8 weight all-gather
+    zero_quantized_gradients: bool = False  # qgZ: int8 grad reduce
+    zero_hpz_partition_size: int = 1        # hpZ: secondary shard group size
+    overlap_comm: bool = True
+    contiguous_gradients: bool = True
+    reduce_bucket_size: int = 5 * 10**8
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ZeroConfig":
+        stage = int(d.get(C.ZERO_STAGE, C.ZERO_STAGE_DEFAULT))
+        if stage not in (0, 1, 2, 3):
+            raise ValueError(f"zero_optimization.stage must be 0-3, got {stage}")
+        return cls(
+            stage=stage,
+            offload_optimizer=OffloadConfig.from_dict(_sub(d, C.OFFLOAD_OPTIMIZER)),
+            offload_param=OffloadConfig.from_dict(_sub(d, C.OFFLOAD_PARAM)),
+            zero_quantized_weights=bool(d.get("zero_quantized_weights", False)),
+            zero_quantized_gradients=bool(d.get("zero_quantized_gradients", False)),
+            zero_hpz_partition_size=int(d.get("zero_hpz_partition_size", 1)),
+            overlap_comm=bool(d.get("overlap_comm", True)),
+            contiguous_gradients=bool(d.get("contiguous_gradients", True)),
+            reduce_bucket_size=int(d.get("reduce_bucket_size", 5 * 10**8)),
+        )
+
+
+@dataclass
+class ParallelismConfig:
+    """Mesh axis sizes. dstpu-native section; also populated from reference-style
+    sections (``tensor_parallel.tp_size``, ``pipeline.stages``,
+    ``sequence_parallel_size``, ``moe.expert_parallel_size``) for config parity."""
+    dp: int = -1
+    fsdp: int = 1
+    tp: int = 1
+    pp: int = 1
+    ep: int = 1
+    sp: int = 1
+
+    @classmethod
+    def from_config_dict(cls, d: Dict[str, Any], zero_stage: int) -> "ParallelismConfig":
+        p = _sub(d, C.PARALLELISM)
+        tp = int(p.get("tp", _sub(d, C.TENSOR_PARALLEL).get("tp_size", 1)))
+        pp = int(p.get("pp", _sub(d, C.PIPELINE).get("stages", 1)))
+        ep = int(p.get("ep", _sub(d, C.MOE).get("expert_parallel_size", 1)))
+        sp = int(p.get("sp", d.get(C.SEQUENCE_PARALLEL_SIZE, 1)))
+        fsdp = int(p.get("fsdp", 0)) or 0
+        dp = int(p.get("dp", 0)) or 0
+        if not fsdp and not dp:
+            # ZeRO>=1 shards over fsdp: default puts all data-parallel replicas on
+            # the fsdp axis; plain DP keeps them on data.
+            if zero_stage >= 1:
+                fsdp, dp = -1, 1
+            else:
+                dp, fsdp = -1, 1
+        elif not fsdp:
+            fsdp = 1
+        elif not dp:
+            dp = 1
+        return cls(dp=dp, fsdp=fsdp, tp=tp, pp=pp, ep=ep, sp=sp)
+
+
+@dataclass
+class ActivationCheckpointingConfig:
+    """``activation_checkpointing`` (reference:
+    ``runtime/activation_checkpointing/checkpointing.py``). Under XLA this maps to
+    ``jax.checkpoint`` policies rather than manual save/recompute."""
+    partition_activations: bool = False
+    number_checkpoints: Optional[int] = None
+    contiguous_memory_optimization: bool = False
+    cpu_checkpointing: bool = False
+    policy: str = "nothing_saveable"  # jax.checkpoint policy name
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ActivationCheckpointingConfig":
+        return cls(partition_activations=bool(d.get("partition_activations", False)),
+                   number_checkpoints=d.get("number_checkpoints"),
+                   contiguous_memory_optimization=bool(
+                       d.get("contiguous_memory_optimization", False)),
+                   cpu_checkpointing=bool(d.get("cpu_checkpointing", False)),
+                   policy=str(d.get("policy", "nothing_saveable")))
+
+
+@dataclass
+class MonitorConfig:
+    """``tensorboard``/``wandb``/``csv_monitor`` sections (reference:
+    ``monitor/config.py``)."""
+    tensorboard_enabled: bool = False
+    tensorboard_output_path: str = ""
+    tensorboard_job_name: str = "DSTpuJobName"
+    wandb_enabled: bool = False
+    wandb_project: Optional[str] = None
+    wandb_team: Optional[str] = None
+    wandb_group: Optional[str] = None
+    csv_enabled: bool = False
+    csv_output_path: str = ""
+    csv_job_name: str = "DSTpuJobName"
+
+    @classmethod
+    def from_config_dict(cls, d: Dict[str, Any]) -> "MonitorConfig":
+        tb = _sub(d, C.MONITOR_TENSORBOARD)
+        wb = _sub(d, C.MONITOR_WANDB)
+        csv = _sub(d, C.MONITOR_CSV)
+        return cls(
+            tensorboard_enabled=bool(tb.get("enabled", False)),
+            tensorboard_output_path=tb.get("output_path", ""),
+            tensorboard_job_name=tb.get("job_name", "DSTpuJobName"),
+            wandb_enabled=bool(wb.get("enabled", False)),
+            wandb_project=wb.get("project"),
+            wandb_team=wb.get("team"),
+            wandb_group=wb.get("group"),
+            csv_enabled=bool(csv.get("enabled", False)),
+            csv_output_path=csv.get("output_path", ""),
+            csv_job_name=csv.get("job_name", "DSTpuJobName"),
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return self.tensorboard_enabled or self.wandb_enabled or self.csv_enabled
+
+
+@dataclass
+class CommsLoggerConfig:
+    """``comms_logger`` section (reference: ``comm/config.py``)."""
+    enabled: bool = False
+    verbose: bool = False
+    debug: bool = False
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "CommsLoggerConfig":
+        return cls(enabled=bool(d.get("enabled", False)),
+                   verbose=bool(d.get("verbose", False)),
+                   debug=bool(d.get("debug", False)))
+
+
+@dataclass
+class FlopsProfilerConfig:
+    """``flops_profiler`` section (reference: ``profiling/config.py``)."""
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FlopsProfilerConfig":
+        return cls(enabled=bool(d.get("enabled", False)),
+                   profile_step=int(d.get("profile_step", 1)),
+                   module_depth=int(d.get("module_depth", -1)),
+                   top_modules=int(d.get("top_modules", 1)),
+                   detailed=bool(d.get("detailed", True)),
+                   output_file=d.get("output_file"))
+
+
+@dataclass
+class CheckpointConfig:
+    """``checkpoint`` section (reference: ``runtime/config.py`` checkpoint_config +
+    tag validation collective ``engine.py:3033``)."""
+    tag_validation: str = "Warn"  # Ignore | Warn | Fail
+    use_node_local_storage: bool = False
+    load_universal: bool = False
+    async_save: bool = True
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "CheckpointConfig":
+        tv = str(d.get("tag_validation", "Warn")).capitalize()
+        if tv not in ("Ignore", "Warn", "Fail"):
+            raise ValueError(f"checkpoint.tag_validation must be Ignore|Warn|Fail, got {tv}")
+        return cls(tag_validation=tv,
+                   use_node_local_storage=bool(d.get("use_node_local_storage", False)),
+                   load_universal=bool(d.get("load_universal", False)),
+                   async_save=bool(d.get("async_save", True)))
+
+
+@dataclass
+class DSTpuConfig:
+    """Top-level typed config (reference: ``DeepSpeedConfig``)."""
+
+    raw: Dict[str, Any]
+    train_batch_size: int
+    train_micro_batch_size_per_gpu: int
+    gradient_accumulation_steps: int
+    optimizer: OptimizerConfig
+    scheduler: SchedulerConfig
+    fp16: Fp16Config
+    bf16: Bf16Config
+    zero: ZeroConfig
+    parallelism: ParallelismConfig
+    activation_checkpointing: ActivationCheckpointingConfig
+    monitor: MonitorConfig
+    comms_logger: CommsLoggerConfig
+    flops_profiler: FlopsProfilerConfig
+    checkpoint: CheckpointConfig
+    gradient_clipping: float = C.GRADIENT_CLIPPING_DEFAULT
+    prescale_gradients: bool = False
+    gradient_predivide_factor: float = 1.0
+    steps_per_print: int = C.STEPS_PER_PRINT_DEFAULT
+    wall_clock_breakdown: bool = False
+    seed: int = C.SEED_DEFAULT
+    dump_state: bool = False
+
+    # ------------------------------------------------------------------ parse
+    @classmethod
+    def from_config(cls, config, dp_world_size: Optional[int] = None) -> "DSTpuConfig":
+        if isinstance(config, (str, os.PathLike)):
+            with open(config) as f:
+                d = json.load(f)
+        elif isinstance(config, dict):
+            d = dict(config)
+        elif isinstance(config, DSTpuConfig):
+            return config
+        else:
+            raise TypeError(f"config must be dict or path, got {type(config)}")
+
+        for key in set(d) & C.IGNORED_REFERENCE_KEYS:
+            logger.warning("config key %r has no TPU analog; ignored", key)
+
+        fp16 = Fp16Config.from_dict(_sub(d, C.FP16))
+        bf16 = Bf16Config.from_dict(_sub(d, C.BF16))
+        if fp16.enabled and bf16.enabled:
+            raise ValueError("fp16 and bf16 cannot both be enabled")
+        zero = ZeroConfig.from_dict(_sub(d, C.ZERO_OPTIMIZATION))
+
+        cfg = cls(
+            raw=d,
+            train_batch_size=0,
+            train_micro_batch_size_per_gpu=0,
+            gradient_accumulation_steps=0,
+            optimizer=OptimizerConfig.from_dict(_sub(d, C.OPTIMIZER)),
+            scheduler=SchedulerConfig.from_dict(_sub(d, C.SCHEDULER)),
+            fp16=fp16,
+            bf16=bf16,
+            zero=zero,
+            parallelism=ParallelismConfig.from_config_dict(d, zero.stage),
+            activation_checkpointing=ActivationCheckpointingConfig.from_dict(
+                _sub(d, C.ACTIVATION_CHECKPOINTING)),
+            monitor=MonitorConfig.from_config_dict(d),
+            comms_logger=CommsLoggerConfig.from_dict(_sub(d, C.COMMS_LOGGER)),
+            flops_profiler=FlopsProfilerConfig.from_dict(_sub(d, C.FLOPS_PROFILER)),
+            checkpoint=CheckpointConfig.from_dict(_sub(d, C.CHECKPOINT)),
+            gradient_clipping=float(d.get(C.GRADIENT_CLIPPING,
+                                          C.GRADIENT_CLIPPING_DEFAULT)),
+            prescale_gradients=bool(d.get(C.PRESCALE_GRADIENTS, False)),
+            gradient_predivide_factor=float(d.get(C.GRADIENT_PREDIVIDE_FACTOR, 1.0)),
+            steps_per_print=int(d.get(C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT)),
+            wall_clock_breakdown=bool(d.get(C.WALL_CLOCK_BREAKDOWN, False)),
+            seed=int(d.get(C.SEED, C.SEED_DEFAULT)),
+            dump_state=bool(d.get(C.DUMP_STATE, False)),
+        )
+        if dp_world_size is not None:
+            cfg.resolve_batch_sizes(dp_world_size)
+        return cfg
+
+    # ---------------------------------------------------------- batch invariant
+    def resolve_batch_sizes(self, dp_world_size: int) -> None:
+        """Enforce/derive ``train_batch = micro_batch × grad_accum × dp_world``
+        (reference: ``runtime/config.py`` ``_set_batch_related_parameters``)."""
+        d = self.raw
+        tb = d.get(C.TRAIN_BATCH_SIZE)
+        mb = d.get(C.TRAIN_MICRO_BATCH_SIZE_PER_GPU)
+        gas = d.get(C.GRADIENT_ACCUMULATION_STEPS)
+        tb = None if tb == AUTO else tb
+        mb = None if mb == AUTO else mb
+        gas = None if gas == AUTO else gas
+
+        if tb is not None and mb is not None and gas is not None:
+            if tb != mb * gas * dp_world_size:
+                raise ValueError(
+                    f"batch invariant violated: train_batch_size={tb} != "
+                    f"micro({mb}) × grad_accum({gas}) × dp_world({dp_world_size})")
+        elif tb is not None and mb is not None:
+            if tb % (mb * dp_world_size) != 0:
+                raise ValueError(
+                    f"train_batch_size={tb} not divisible by micro({mb}) × "
+                    f"dp_world({dp_world_size})")
+            gas = tb // (mb * dp_world_size)
+        elif tb is not None and gas is not None:
+            if tb % (gas * dp_world_size) != 0:
+                raise ValueError(
+                    f"train_batch_size={tb} not divisible by grad_accum({gas}) × "
+                    f"dp_world({dp_world_size})")
+            mb = tb // (gas * dp_world_size)
+        elif mb is not None:
+            gas = gas or 1
+            tb = mb * gas * dp_world_size
+        elif tb is not None:
+            mb = max(1, tb // dp_world_size)
+            gas = tb // (mb * dp_world_size)
+            if tb != mb * gas * dp_world_size:
+                raise ValueError(
+                    f"train_batch_size={tb} not divisible by dp_world({dp_world_size})")
+        else:
+            raise ValueError(
+                "at least one of train_batch_size / train_micro_batch_size_per_gpu "
+                "must be configured")
+        self.train_batch_size = int(tb)
+        self.train_micro_batch_size_per_gpu = int(mb)
+        self.gradient_accumulation_steps = int(gas)
+
+    # ------------------------------------------------------------------ helpers
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+
+        if self.bf16.enabled:
+            return jnp.bfloat16
+        if self.fp16.enabled:
+            return jnp.float16
+        return jnp.float32
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = dict(self.raw)
+        out[C.TRAIN_BATCH_SIZE] = self.train_batch_size
+        out[C.TRAIN_MICRO_BATCH_SIZE_PER_GPU] = self.train_micro_batch_size_per_gpu
+        out[C.GRADIENT_ACCUMULATION_STEPS] = self.gradient_accumulation_steps
+        return out

@@ -1,0 +1,80 @@
+"""fp16 loss scaling.
+
+Analog of ``deepspeed/runtime/fp16/loss_scaler.py`` (``LossScaler`` static,
+``DynamicLossScaler`` with scale window/hysteresis) used by ``FP16_Optimizer``
+(``runtime/fp16/fused_optimizer.py:31``).
+
+Functional design: the scaler is an immutable pytree threaded through the jitted
+train step. Overflow check = non-finite grads; on overflow the step is skipped
+(grads zeroed, optimizer state untouched) and the scale halves after ``hysteresis``
+consecutive overflows; after ``scale_window`` clean steps it doubles — the exact
+reference policy, but branch-free under jit via ``jnp.where``.
+"""
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    scale: jnp.ndarray          # current loss scale (f32 scalar)
+    good_steps: jnp.ndarray     # consecutive overflow-free steps (i32)
+    hysteresis_left: jnp.ndarray  # remaining tolerated overflows before halving (i32)
+    overflows: jnp.ndarray      # cumulative skipped steps (i32)
+
+
+def init_loss_scale(initial_scale: float, dynamic: bool, hysteresis: int = 2) -> LossScaleState:
+    return LossScaleState(
+        scale=jnp.asarray(initial_scale, jnp.float32),
+        good_steps=jnp.zeros((), jnp.int32),
+        hysteresis_left=jnp.asarray(hysteresis if dynamic else 2**30, jnp.int32),
+        overflows=jnp.zeros((), jnp.int32),
+    )
+
+
+def grads_finite(grads) -> jnp.ndarray:
+    """Global overflow check (reference: ``CHECK_OVERFLOW``/``has_overflow`` paths —
+    there a device-wide allreduce of an inf flag; here a tree-reduce XLA fuses)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.asarray(True)
+    finites = [jnp.all(jnp.isfinite(g)) for g in leaves]
+    return jnp.stack(finites).all()
+
+
+def update_loss_scale(state: LossScaleState, finite: jnp.ndarray, *,
+                      dynamic: bool, scale_window: int, scale_factor: float = 2.0,
+                      min_scale: float = 1.0, hysteresis: int = 2) -> LossScaleState:
+    """One scaler transition (reference ``DynamicLossScaler.update_scale``)."""
+    if not dynamic:
+        return state._replace(overflows=state.overflows + (~finite).astype(jnp.int32))
+
+    # overflow path: consume hysteresis; halve scale when exhausted
+    hys = jnp.where(finite, state.hysteresis_left, state.hysteresis_left - 1)
+    halve = (~finite) & (hys <= 0)
+    new_scale = jnp.where(halve, jnp.maximum(state.scale / scale_factor, min_scale),
+                          state.scale)
+    hys = jnp.where(halve, hysteresis, hys)
+
+    # clean-window path: double scale every `scale_window` good steps
+    good = jnp.where(finite, state.good_steps + 1, 0)
+    grow = finite & (good >= scale_window)
+    new_scale = jnp.where(grow, new_scale * scale_factor, new_scale)
+    good = jnp.where(grow, 0, good)
+    hys = jnp.where(grow, hysteresis, hys)
+
+    return LossScaleState(
+        scale=new_scale,
+        good_steps=good.astype(jnp.int32),
+        hysteresis_left=hys.astype(jnp.int32),
+        overflows=state.overflows + (~finite).astype(jnp.int32),
+    )
+
+
+def scale_loss(loss, state: LossScaleState):
+    return loss * state.scale.astype(loss.dtype)
+
+
+def unscale_grads(grads, state: LossScaleState):
+    inv = (1.0 / state.scale).astype(jnp.float32)
+    return jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) * inv, grads)

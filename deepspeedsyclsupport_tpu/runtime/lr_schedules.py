@@ -1,0 +1,143 @@
+"""LR schedules.
+
+Analog of ``deepspeed/runtime/lr_schedules.py`` (878 LoC: LRRangeTest, OneCycle,
+WarmupLR, WarmupDecayLR, WarmupCosineLR). The reference implements stateful
+per-step ``.step()`` objects mutating optimizer param groups; the TPU design expresses
+each as a pure ``step -> lr`` schedule (optax convention) compiled into the jitted
+update, so LR math costs nothing at runtime and is checkpoint-free (the step counter
+lives in the optimizer state).
+"""
+import math
+from typing import Any, Callable, Dict, Optional
+
+import optax
+
+Schedule = Callable[[Any], Any]
+
+LR_RANGE_TEST = "LRRangeTest"
+ONE_CYCLE = "OneCycle"
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+WARMUP_COSINE_LR = "WarmupCosineLR"
+
+VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR, WARMUP_DECAY_LR,
+                      WARMUP_COSINE_LR]
+
+
+def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 0.001,
+              warmup_num_steps: int = 1000, warmup_type: str = "log") -> Schedule:
+    """WarmupLR (reference ``lr_schedules.py`` class WarmupLR): ramp from min to max
+    over ``warmup_num_steps`` (log or linear), then hold."""
+    import jax.numpy as jnp
+
+    warmup_num_steps = max(2, warmup_num_steps)
+
+    def sched(step):
+        s = jnp.minimum(jnp.asarray(step, jnp.float32), warmup_num_steps)
+        if warmup_type == "log":
+            frac = jnp.log1p(s) / math.log(warmup_num_steps + 1)
+        else:
+            frac = s / warmup_num_steps
+        return warmup_min_lr + (warmup_max_lr - warmup_min_lr) * jnp.minimum(frac, 1.0)
+
+    return sched
+
+
+def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 0.001, warmup_num_steps: int = 1000,
+                    warmup_type: str = "log") -> Schedule:
+    """WarmupDecayLR: warmup then linear decay to 0 at ``total_num_steps``."""
+    import jax.numpy as jnp
+
+    base = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+
+    def sched(step):
+        s = jnp.asarray(step, jnp.float32)
+        decay = jnp.clip((total_num_steps - s) /
+                         max(1.0, total_num_steps - warmup_num_steps), 0.0, 1.0)
+        return jnp.where(s < warmup_num_steps, base(step), warmup_max_lr * decay)
+
+    return sched
+
+
+def warmup_cosine_lr(total_num_steps: int, warmup_min_ratio: float = 0.0,
+                     warmup_num_steps: int = 1000, cos_min_ratio: float = 0.0001,
+                     warmup_max_lr: float = 0.001) -> Schedule:
+    """WarmupCosineLR: linear warmup then cosine decay to ``cos_min_ratio``."""
+    import jax.numpy as jnp
+
+    def sched(step):
+        s = jnp.asarray(step, jnp.float32)
+        warm = warmup_min_ratio + (1 - warmup_min_ratio) * jnp.minimum(
+            s / max(1, warmup_num_steps), 1.0)
+        prog = jnp.clip((s - warmup_num_steps) /
+                        max(1, total_num_steps - warmup_num_steps), 0.0, 1.0)
+        cos = cos_min_ratio + (1 - cos_min_ratio) * 0.5 * (1 + jnp.cos(math.pi * prog))
+        ratio = jnp.where(s < warmup_num_steps, warm, cos)
+        return warmup_max_lr * ratio
+
+    return sched
+
+
+def one_cycle(cycle_min_lr: float, cycle_max_lr: float,
+              cycle_first_step_size: int = 2000,
+              cycle_second_step_size: Optional[int] = None,
+              decay_step_size: int = 0, decay_lr_rate: float = 0.0,
+              **_ignored) -> Schedule:
+    """OneCycle (reference ``lr_schedules.py`` class OneCycle): min→max over the
+    first leg, max→min over the second, then optional decay below min."""
+    import jax.numpy as jnp
+
+    second = cycle_second_step_size if cycle_second_step_size is not None \
+        else cycle_first_step_size
+    cycle_len = cycle_first_step_size + second
+
+    def sched(step):
+        s = jnp.asarray(step, jnp.float32)
+        up = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * (s / cycle_first_step_size)
+        down = cycle_max_lr - (cycle_max_lr - cycle_min_lr) * (
+            (s - cycle_first_step_size) / max(1, second))
+        in_cycle = jnp.where(s < cycle_first_step_size, up, jnp.maximum(down, cycle_min_lr))
+        if decay_step_size > 0:
+            decayed = cycle_min_lr * jnp.maximum(
+                1.0 - decay_lr_rate * ((s - cycle_len) / decay_step_size), 0.0)
+            return jnp.where(s < cycle_len, in_cycle, decayed)
+        return in_cycle
+
+    return sched
+
+
+def lr_range_test(lr_range_test_min_lr: float = 1e-3,
+                  lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0,
+                  lr_range_test_staircase: bool = False) -> Schedule:
+    """LRRangeTest (reference ``lr_schedules.py`` class LRRangeTest): linearly
+    increasing LR sweep for finding LR bounds."""
+    import jax.numpy as jnp
+
+    def sched(step):
+        s = jnp.asarray(step, jnp.float32)
+        interval = jnp.floor(s / lr_range_test_step_size) if lr_range_test_staircase \
+            else s / lr_range_test_step_size
+        return lr_range_test_min_lr * (1.0 + interval * lr_range_test_step_rate)
+
+    return sched
+
+
+_FACTORIES: Dict[str, Callable[..., Schedule]] = {
+    WARMUP_LR: warmup_lr,
+    WARMUP_DECAY_LR: warmup_decay_lr,
+    WARMUP_COSINE_LR: warmup_cosine_lr,
+    ONE_CYCLE: one_cycle,
+    LR_RANGE_TEST: lr_range_test,
+}
+
+
+def build_schedule(sched_type: Optional[str], params: Dict[str, Any],
+                   base_lr: float) -> Schedule:
+    """Config → schedule (reference: engine ``_configure_lr_scheduler``)."""
+    if sched_type is None:
+        return optax.constant_schedule(base_lr)
+    if sched_type not in _FACTORIES:
+        raise ValueError(f"scheduler type {sched_type!r} not in {VALID_LR_SCHEDULES}")
+    return _FACTORIES[sched_type](**params)

@@ -1,0 +1,103 @@
+"""Optimizer construction.
+
+Analog of the reference's optimizer layer:
+
+* ``engine._configure_basic_optimizer`` (``runtime/engine.py:1267``) — name → impl
+  selection (Adam/AdamW/FusedAdam/CPUAdam/Lamb/FusedLamb/Lion/OneBitAdam/…).
+* Native fused kernels ``csrc/adam/multi_tensor_adam.cu``, ``csrc/lamb/``,
+  ``csrc/lion/`` (multi-tensor-apply loops).
+
+TPU shift: a jitted ``optax`` update over the whole param pytree IS the fused
+multi-tensor kernel — XLA fuses the elementwise chain across arrays; no custom kernel
+is warranted (SURVEY.md §2.5 FusedAdam row). ``inject_hyperparams`` exposes the live
+LR in optimizer state for monitors, like the reference reads ``param_groups[0]['lr']``.
+"""
+from typing import Any, Callable, Dict, Optional
+
+import optax
+
+from ..utils.logging import logger
+
+ADAM_OPTIMIZER = "adam"
+ADAMW_OPTIMIZER = "adamw"
+FUSED_ADAM = "fusedadam"
+CPU_ADAM = "cpuadam"  # host-offloaded step: same math, placed on host backend
+LAMB_OPTIMIZER = "lamb"
+FUSED_LAMB = "fusedlamb"
+LION_OPTIMIZER = "lion"
+FUSED_LION = "fusedlion"
+SGD_OPTIMIZER = "sgd"
+ADAGRAD_OPTIMIZER = "adagrad"
+ONEBIT_ADAM = "onebitadam"
+ZERO_ONE_ADAM = "zerooneadam"
+ONEBIT_LAMB = "onebitlamb"
+
+
+def _common(params: Dict[str, Any]):
+    lr = float(params.get("lr", 1e-3))
+    betas = params.get("betas", (0.9, 0.999))
+    eps = float(params.get("eps", 1e-8))
+    wd = float(params.get("weight_decay", 0.0))
+    return lr, (float(betas[0]), float(betas[1])), eps, wd
+
+
+def build_optimizer(opt_type: str, params: Dict[str, Any],
+                    lr_schedule: Optional[Callable] = None) -> optax.GradientTransformation:
+    """Map config ``optimizer.type``+``params`` to an optax transform.
+
+    1-bit variants (error-feedback compressed allreduce, reference
+    ``runtime/fp16/onebit/``) have no benefit when gradients are reduce-scattered
+    over ICI by XLA; they resolve to their dense counterparts with a notice (the
+    compression analog for cross-DCN traffic lives in ``parallel/quantized.py``).
+    """
+    t = opt_type.lower().replace("_", "")
+    lr, betas, eps, wd = _common(params)
+    schedule = lr_schedule if lr_schedule is not None else lr
+
+    if t in (ONEBIT_ADAM, ZERO_ONE_ADAM):
+        logger.warning("%s resolves to adam on TPU (ICI makes 1-bit compression moot)",
+                       opt_type)
+        t = ADAM_OPTIMIZER
+    if t == ONEBIT_LAMB:
+        logger.warning("%s resolves to lamb on TPU", opt_type)
+        t = LAMB_OPTIMIZER
+
+    if t in (ADAMW_OPTIMIZER, FUSED_ADAM, CPU_ADAM):
+        # reference FusedAdam defaults adam_w_mode=True → AdamW semantics
+        tx = optax.inject_hyperparams(optax.adamw)(
+            learning_rate=schedule, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
+    elif t == ADAM_OPTIMIZER:
+        if params.get("adam_w_mode", True):
+            tx = optax.inject_hyperparams(optax.adamw)(
+                learning_rate=schedule, b1=betas[0], b2=betas[1], eps=eps,
+                weight_decay=wd)
+        else:
+            tx = optax.inject_hyperparams(optax.adam)(
+                learning_rate=schedule, b1=betas[0], b2=betas[1], eps=eps)
+    elif t in (LAMB_OPTIMIZER, FUSED_LAMB):
+        tx = optax.inject_hyperparams(optax.lamb)(
+            learning_rate=schedule, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
+    elif t in (LION_OPTIMIZER, FUSED_LION):
+        tx = optax.inject_hyperparams(optax.lion)(
+            learning_rate=schedule, b1=betas[0], b2=betas[1], weight_decay=wd)
+    elif t == SGD_OPTIMIZER:
+        tx = optax.inject_hyperparams(optax.sgd)(
+            learning_rate=schedule, momentum=float(params.get("momentum", 0.0)))
+    elif t == ADAGRAD_OPTIMIZER:
+        tx = optax.inject_hyperparams(optax.adagrad)(learning_rate=schedule, eps=eps)
+    else:
+        raise ValueError(f"unknown optimizer type {opt_type!r}")
+    return tx
+
+
+def current_lr(opt_state) -> Any:
+    """Pull the live learning rate out of an inject_hyperparams state (reference:
+    ``engine.get_lr``)."""
+    try:
+        return opt_state.hyperparams["learning_rate"]
+    except (AttributeError, KeyError, TypeError):
+        for leaf in (opt_state if isinstance(opt_state, tuple) else [opt_state]):
+            hp = getattr(leaf, "hyperparams", None)
+            if hp and "learning_rate" in hp:
+                return hp["learning_rate"]
+    return None

@@ -1,0 +1,158 @@
+"""ZeRO-style memory partitioning via sharding specs.
+
+TPU-native analog of the reference's ZeRO machinery (SURVEY.md §2.4):
+
+* stage 1 — optimizer-state sharding: ``DeepSpeedZeroOptimizer`` with
+  ``partition_gradients=False`` (``runtime/zero/stage_1_and_2.py:96``).
+* stage 2 — + gradient sharding: IPG buckets + ``average_tensor`` reduce-scatter
+  (``stage_1_and_2.py:894,1004``).
+* stage 3 — + parameter sharding: ``DeepSpeedZeroOptimizer_Stage3``
+  (``stage3.py:73``), param lifecycle hooks (``parameter_offload.py:201``),
+  prefetch coordinator (``partitioned_param_coordinator.py:58``).
+
+The reference needs ~8k LoC of hooks, buckets, and streams because torch executes
+eagerly: it must *manually* gather params before use, free them after, and overlap
+reduce-scatter with backward. Under XLA the same data movement is derived from
+placement: declare each tensor's sharding over the ``fsdp`` mesh axis and the SPMD
+partitioner inserts the all-gathers (param use), reduce-scatters (grad math), and
+overlaps them with compute (what the prefetch coordinator/overlap_comm hand-tune).
+What remains our job is the *placement policy* — which tensors shard, over which
+axis, along which dimension — plus offload targeting and the numerics ring
+(loss scaling, grad clipping, overflow) which lives in ``engine.py``/``loss_scaler.py``.
+
+Semantics map (all stages keep DP gradient averaging):
+
+=======  ==========================  ====================================
+stage    sharded state               sharding rule here
+0        nothing                     params/opt replicated over fsdp
+1        optimizer state             opt moments sharded, params replicated
+2        + gradients                 same placement as 1 (XLA reduce-scatters
+                                     grads into the sharded update; the explicit
+                                     analog of stage-2 bucketing)
+3        + parameters                params sharded too (FSDP)
+=======  ==========================  ====================================
+"""
+import math
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..comm.topology import MeshTopology
+from ..utils.logging import logger
+
+# Params smaller than this stay replicated at stage 3, mirroring the reference's
+# ``stage3_param_persistence_threshold`` (small params are cheaper re-used than
+# re-gathered; stage3.py keeps them resident for the same reason).
+DEFAULT_PERSISTENCE_THRESHOLD = 10_000
+
+
+def choose_shard_dim(shape: Tuple[int, ...], n_shards: int,
+                     threshold: int = DEFAULT_PERSISTENCE_THRESHOLD) -> Optional[int]:
+    """Pick the dimension to shard over fsdp: the largest dim divisible by
+    ``n_shards``; None if the tensor is too small or nothing divides."""
+    if n_shards <= 1:
+        return None
+    size = math.prod(shape) if shape else 0
+    if size < threshold:
+        return None
+    candidates = [i for i, d in enumerate(shape) if d % n_shards == 0]
+    if not candidates:
+        return None
+    return max(candidates, key=lambda i: shape[i])
+
+
+def param_sharding(topo: MeshTopology, stage: int,
+                   threshold: int = DEFAULT_PERSISTENCE_THRESHOLD,
+                   extra_rules: Optional[Callable] = None) -> Callable:
+    """Build a ``leaf -> NamedSharding`` function for parameters.
+
+    ``extra_rules(path, shape)`` may return a PartitionSpec to compose tensor
+    parallelism (TP specs win on their dims; fsdp takes a remaining dim).
+    """
+    mesh = topo.mesh
+    n = topo.axis_sizes["fsdp"]
+
+    def rule(path, leaf) -> NamedSharding:
+        shape = np.shape(leaf)
+        tp_spec = list(extra_rules(path, shape)) if extra_rules else []
+        tp_spec += [None] * (len(shape) - len(tp_spec))
+        if stage >= 3 and n > 1:
+            used = {ax for s in tp_spec for ax in (s if isinstance(s, tuple) else (s,))
+                    if ax}
+            free = [i for i, s in enumerate(tp_spec) if s is None]
+            # shard the largest free, divisible dim over fsdp
+            div = [i for i in free
+                   if shape[i] % n == 0] if "fsdp" not in used else []
+            size = math.prod(shape) if shape else 0
+            if div and size >= threshold:
+                i = max(div, key=lambda j: shape[j])
+                tp_spec[i] = "fsdp"
+        return NamedSharding(mesh, PartitionSpec(*tp_spec))
+
+    return rule
+
+
+def tree_param_shardings(params, topo: MeshTopology, stage: int,
+                         threshold: int = DEFAULT_PERSISTENCE_THRESHOLD,
+                         extra_rules: Optional[Callable] = None):
+    rule = param_sharding(topo, stage, threshold, extra_rules)
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def tree_optimizer_shardings(opt_state, params, param_shardings, topo: MeshTopology,
+                             stage: int,
+                             threshold: int = DEFAULT_PERSISTENCE_THRESHOLD):
+    """Sharding pytree for optimizer state.
+
+    Moment tensors (same shape as a param) follow: stage>=3 → the param's sharding;
+    stage 1/2 → sharded over fsdp on their largest divisible dim even though the
+    param is replicated (that IS ZeRO-1/2: master/opt partitions with full params).
+    Scalars (step counters, injected hyperparams) replicate.
+    """
+    mesh = topo.mesh
+    n = topo.axis_sizes["fsdp"]
+
+    # Index params by key path → sharding. Optimizer moments (optax ScaleByAdamState
+    # .mu/.nu etc.) share the param tree structure, so a moment leaf's key path ends
+    # with its param's key path; matching on (path suffix, shape) — not shape alone —
+    # keeps two same-shaped params with different TP shardings distinct.
+    path_to_sharding = {}
+    p_paths = jax.tree_util.tree_flatten_with_path(params)[0]
+    s_leaves = jax.tree_util.tree_leaves(
+        param_shardings, is_leaf=lambda x: isinstance(x, NamedSharding))
+    for (kp, p), s in zip(p_paths, s_leaves):
+        path_to_sharding[jax.tree_util.keystr(kp)] = (np.shape(p), s)
+
+    replicated = NamedSharding(mesh, PartitionSpec())
+
+    def rule(kp, leaf):
+        shape = np.shape(leaf)
+        if not shape:
+            return replicated
+        if stage >= 3:
+            for i in range(len(kp)):
+                ent = path_to_sharding.get(jax.tree_util.keystr(kp[i:]))
+                if ent is not None and ent[0] == shape:
+                    return ent[1]
+        if stage >= 1:
+            dim = choose_shard_dim(shape, n, threshold)
+            if dim is not None:
+                spec = [None] * len(shape)
+                spec[dim] = "fsdp"
+                return NamedSharding(mesh, PartitionSpec(*spec))
+        return replicated
+
+    return jax.tree_util.tree_map_with_path(rule, opt_state)
+
+
+def describe_memory_plan(params, topo: MeshTopology, stage: int) -> str:
+    """Human-readable partition report (reference: ``see_memory_usage`` +
+    stage3 partition logging)."""
+    n_params = sum(math.prod(np.shape(p)) for p in jax.tree_util.tree_leaves(params))
+    n = topo.axis_sizes["fsdp"]
+    param_factor = n if stage >= 3 and n > 1 else 1
+    opt_factor = n if stage >= 1 and n > 1 else 1
+    return (f"ZeRO stage {stage}: {n_params / 1e6:.1f}M params, fsdp={n}; "
+            f"param mem 1/{param_factor}, optimizer mem 1/{opt_factor} per device")

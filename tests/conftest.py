@@ -13,8 +13,15 @@ import os
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
+
+import jax  # noqa: E402
+
+# A site-level TPU plugin may have force-set jax_platforms at interpreter start
+# (before this conftest ran), overriding the env var; re-pin to host CPU so the
+# virtual 8-device mesh is what every test sees.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

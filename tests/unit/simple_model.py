@@ -1,0 +1,62 @@
+"""Test model fixtures (analog of reference ``tests/unit/simple_model.py``:
+SimpleModel + random_dataloader used across the engine/ZeRO/checkpoint suites)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SimpleModel:
+    """Two-layer MLP regression model following the engine's model protocol
+    (``init_params`` / ``loss``)."""
+
+    def __init__(self, hidden_dim: int = 32, nlayers: int = 2, seed: int = 0):
+        self.hidden_dim = hidden_dim
+        self.nlayers = nlayers
+        self.seed = seed
+
+    def init_params(self):
+        rng = np.random.default_rng(self.seed)
+        params = {}
+        for i in range(self.nlayers):
+            params[f"layer_{i}"] = {
+                "w": rng.normal(0, 0.1, (self.hidden_dim, self.hidden_dim)).astype(
+                    np.float32),
+                "b": np.zeros((self.hidden_dim,), np.float32),
+            }
+        return params
+
+    def forward(self, params, x):
+        h = x
+        for i in range(self.nlayers):
+            lyr = params[f"layer_{i}"]
+            h = jnp.tanh(h @ lyr["w"] + lyr["b"])
+        return h
+
+    def loss(self, params, batch, rng):
+        x, y = batch["x"], batch["y"]
+        pred = self.forward(params, x)
+        return jnp.mean((pred - y.astype(pred.dtype)) ** 2)
+
+
+def random_dataset(batch_size: int, hidden_dim: int = 32, n_batches: int = 8,
+                   seed: int = 1):
+    """Deterministic synthetic regression data (reference ``random_dataloader``)."""
+    rng = np.random.default_rng(seed)
+    target_w = rng.normal(0, 0.5, (hidden_dim, hidden_dim)).astype(np.float32)
+    out = []
+    for _ in range(n_batches):
+        x = rng.normal(0, 1, (batch_size, hidden_dim)).astype(np.float32)
+        y = np.tanh(x @ target_w)
+        out.append({"x": x, "y": y})
+    return out
+
+
+def simple_config(**overrides):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "steps_per_print": 1000,
+    }
+    cfg.update(overrides)
+    return cfg

@@ -1,0 +1,110 @@
+"""Config system tests (reference: ``tests/unit/runtime/test_ds_config_*.py``)."""
+import json
+
+import pytest
+
+from deepspeedsyclsupport_tpu.runtime.config import DSTpuConfig
+
+
+def test_batch_invariant_derive_gas():
+    cfg = DSTpuConfig.from_config(
+        {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2},
+        dp_world_size=8)
+    assert cfg.gradient_accumulation_steps == 2
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_invariant_derive_micro():
+    cfg = DSTpuConfig.from_config(
+        {"train_batch_size": 64, "gradient_accumulation_steps": 4}, dp_world_size=8)
+    assert cfg.train_micro_batch_size_per_gpu == 2
+
+
+def test_batch_invariant_derive_train():
+    cfg = DSTpuConfig.from_config(
+        {"train_micro_batch_size_per_gpu": 3}, dp_world_size=8)
+    assert cfg.train_batch_size == 24
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_invariant_violation():
+    with pytest.raises(ValueError, match="batch invariant"):
+        DSTpuConfig.from_config(
+            {"train_batch_size": 100, "train_micro_batch_size_per_gpu": 2,
+             "gradient_accumulation_steps": 2}, dp_world_size=8)
+
+
+def test_batch_missing():
+    with pytest.raises(ValueError, match="at least one"):
+        DSTpuConfig.from_config({}, dp_world_size=8)
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(ValueError, match="cannot both"):
+        DSTpuConfig.from_config({"train_batch_size": 8,
+                                 "fp16": {"enabled": True},
+                                 "bf16": {"enabled": True}}, dp_world_size=8)
+
+
+def test_zero_stage_validation():
+    with pytest.raises(ValueError, match="stage"):
+        DSTpuConfig.from_config({"train_batch_size": 8,
+                                 "zero_optimization": {"stage": 5}}, dp_world_size=8)
+
+
+def test_reference_config_parses(tmp_path):
+    """A DeepSpeed-style JSON file parses unmodified."""
+    ref = {
+        "train_batch_size": 16,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW",
+                      "params": {"lr": 3e-4, "betas": [0.9, 0.95],
+                                 "weight_decay": 0.1}},
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_max_lr": 3e-4, "warmup_num_steps": 10}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2,
+                              "offload_optimizer": {"device": "cpu"}},
+        "gradient_clipping": 1.0,
+        "wall_clock_breakdown": False,
+        "sparse_gradients": False,
+    }
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps(ref))
+    cfg = DSTpuConfig.from_config(str(p), dp_world_size=8)
+    assert cfg.optimizer.type == "adamw"
+    assert cfg.zero.stage == 2
+    assert cfg.zero.offload_optimizer.device == "cpu"
+    assert cfg.bf16.enabled and not cfg.fp16.enabled
+    assert cfg.gradient_clipping == 1.0
+    assert cfg.scheduler.type == "WarmupLR"
+    assert cfg.compute_dtype.__name__ == "bfloat16"
+
+
+def test_fp16_scale_config():
+    cfg = DSTpuConfig.from_config(
+        {"train_batch_size": 8,
+         "fp16": {"enabled": True, "initial_scale_power": 8,
+                  "loss_scale_window": 100}}, dp_world_size=8)
+    assert cfg.fp16.dynamic
+    assert cfg.fp16.initial_scale == 256.0
+
+
+def test_parallelism_defaults_zero_vs_dp():
+    cfg = DSTpuConfig.from_config({"train_batch_size": 8,
+                                   "zero_optimization": {"stage": 2}},
+                                  dp_world_size=8)
+    assert cfg.parallelism.fsdp == -1 and cfg.parallelism.dp == 1
+    cfg2 = DSTpuConfig.from_config({"train_batch_size": 8}, dp_world_size=8)
+    assert cfg2.parallelism.dp == -1 and cfg2.parallelism.fsdp == 1
+
+
+def test_parallelism_reference_sections():
+    cfg = DSTpuConfig.from_config(
+        {"train_batch_size": 8,
+         "tensor_parallel": {"tp_size": 2},
+         "pipeline": {"stages": 2},
+         "sequence_parallel_size": 2}, dp_world_size=1)
+    assert cfg.parallelism.tp == 2
+    assert cfg.parallelism.pp == 2
+    assert cfg.parallelism.sp == 2

@@ -1,0 +1,61 @@
+"""LR schedule math (reference: ``tests/unit/runtime/test_lr_schedulers.py``)."""
+import numpy as np
+import pytest
+
+from deepspeedsyclsupport_tpu.runtime import lr_schedules as lrs
+
+
+def test_warmup_lr_linear():
+    s = lrs.warmup_lr(0.0, 0.1, 100, warmup_type="linear")
+    assert float(s(0)) == 0.0
+    np.testing.assert_allclose(float(s(50)), 0.05, rtol=1e-5)
+    np.testing.assert_allclose(float(s(100)), 0.1, rtol=1e-5)
+    np.testing.assert_allclose(float(s(500)), 0.1, rtol=1e-5)  # hold
+
+
+def test_warmup_lr_log():
+    s = lrs.warmup_lr(0.0, 0.1, 100, warmup_type="log")
+    assert float(s(0)) == 0.0
+    assert float(s(10)) > 0.1 * 10 / 100  # log ramps faster early
+    np.testing.assert_allclose(float(s(100)), 0.1, rtol=1e-3)
+
+
+def test_warmup_decay():
+    s = lrs.warmup_decay_lr(200, 0.0, 0.1, 100, warmup_type="linear")
+    np.testing.assert_allclose(float(s(100)), 0.1, rtol=1e-5)
+    np.testing.assert_allclose(float(s(150)), 0.05, rtol=1e-5)
+    np.testing.assert_allclose(float(s(200)), 0.0, atol=1e-8)
+
+
+def test_warmup_cosine():
+    s = lrs.warmup_cosine_lr(200, warmup_num_steps=100, warmup_max_lr=0.1,
+                             cos_min_ratio=0.0)
+    np.testing.assert_allclose(float(s(100)), 0.1, rtol=1e-5)
+    np.testing.assert_allclose(float(s(150)), 0.05, rtol=1e-4)  # cos midpoint
+    np.testing.assert_allclose(float(s(200)), 0.0, atol=1e-6)
+
+
+def test_one_cycle():
+    s = lrs.one_cycle(0.01, 0.1, cycle_first_step_size=100)
+    np.testing.assert_allclose(float(s(0)), 0.01, rtol=1e-5)
+    np.testing.assert_allclose(float(s(100)), 0.1, rtol=1e-5)
+    np.testing.assert_allclose(float(s(200)), 0.01, rtol=1e-5)
+    # with decay below min
+    s2 = lrs.one_cycle(0.01, 0.1, cycle_first_step_size=100,
+                       decay_step_size=100, decay_lr_rate=0.5)
+    assert float(s2(300)) < 0.01
+
+
+def test_lr_range_test():
+    s = lrs.lr_range_test(1e-3, 100, 1.0)
+    np.testing.assert_allclose(float(s(0)), 1e-3, rtol=1e-5)
+    np.testing.assert_allclose(float(s(100)), 2e-3, rtol=1e-5)
+    stair = lrs.lr_range_test(1e-3, 100, 1.0, lr_range_test_staircase=True)
+    np.testing.assert_allclose(float(stair(150)), 2e-3, rtol=1e-5)
+
+
+def test_build_schedule_errors():
+    with pytest.raises(ValueError, match="not in"):
+        lrs.build_schedule("Bogus", {}, 1e-3)
+    s = lrs.build_schedule(None, {}, 5e-4)
+    np.testing.assert_allclose(float(s(123)), 5e-4)
