@@ -1,0 +1,4 @@
+"""Built-in model families (framework-owned; see transformer.py docstring for how
+this replaces the reference's module_inject/model_implementations machinery)."""
+from .config import ModelConfig, PRESETS, get_config  # noqa: F401
+from .transformer import CausalLM, KVCache, build_model  # noqa: F401

@@ -1,0 +1,125 @@
+"""Model configuration for the built-in transformer families.
+
+The reference ships per-architecture *policies* that map external (HF) modules onto
+its fused containers (``deepspeed/module_inject/containers/*.py``, 19 families) and a
+v2 model zoo (``deepspeed/inference/v2/model_implementations/``: llama_v2, mistral,
+mixtral, opt, falcon, phi). Here the framework owns the model definition outright —
+one config dataclass covers the dense Llama/GPT family and the Mixtral-style MoE
+family; per-family presets live in :data:`PRESETS`.
+"""
+from dataclasses import dataclass, field, replace
+from typing import Optional, Tuple
+
+
+@dataclass
+class ModelConfig:
+    # Core dimensions
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: Optional[int] = None  # None => MHA; < num_heads => GQA
+    head_dim: Optional[int] = None      # None => hidden_size // num_heads
+    max_seq_len: int = 4096
+
+    # Architecture knobs
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    attn_impl: str = "auto"  # auto | xla | flash | ring | ulysses
+    activation: str = "silu"   # silu (SwiGLU) | gelu (GeGLU)
+    use_bias: bool = False
+
+    # MoE (Mixtral-family; reference: deepspeed/moe/sharded_moe.py)
+    num_experts: int = 0            # 0 => dense MLP
+    num_experts_per_tok: int = 2    # top-k routing
+    moe_layer_freq: int = 1         # every Nth layer is MoE
+    capacity_factor: float = 1.25
+    aux_loss_coef: float = 0.01
+    router_jitter: float = 0.0
+
+    # Training-time behavior
+    remat: bool = False             # jax.checkpoint each layer (activation ckpt)
+    scan_layers: bool = True        # lax.scan over stacked layer params
+    dropout: float = 0.0
+    dtype: str = "bfloat16"         # compute dtype hint (engine may override)
+
+    # Initializer
+    initializer_range: float = 0.02
+
+    def __post_init__(self):
+        if self.num_kv_heads is None:
+            self.num_kv_heads = self.num_heads
+        if self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_heads
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    def is_moe_layer(self, layer_idx: int) -> bool:
+        return self.num_experts > 0 and (layer_idx % self.moe_layer_freq == 0)
+
+    @property
+    def any_moe(self) -> bool:
+        return self.num_experts > 0
+
+    def param_count(self) -> int:
+        """Approximate parameter count (embeddings + layers)."""
+        d, f, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        attn = d * self.q_dim + 2 * d * self.kv_dim + self.q_dim * d
+        mlp = 3 * d * f
+        if self.num_experts > 0:
+            mlp = mlp * self.num_experts + d * self.num_experts
+        per_layer = attn + mlp + 2 * d
+        total = per_layer * self.num_layers + v * d + d
+        if not self.tie_embeddings:
+            total += d * v
+        return total
+
+
+def _p(**kw) -> ModelConfig:
+    return ModelConfig(**kw)
+
+
+PRESETS = {
+    # Test-scale configs (CI / CPU-mesh friendly)
+    "tiny": _p(vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
+               num_heads=4, num_kv_heads=2, max_seq_len=256),
+    "tiny-moe": _p(vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
+                   num_heads=4, num_kv_heads=2, max_seq_len=256, num_experts=4,
+                   num_experts_per_tok=2),
+    "small": _p(vocab_size=8192, hidden_size=512, intermediate_size=1408,
+                num_layers=8, num_heads=8, num_kv_heads=8, max_seq_len=2048),
+    # GPT-2/BERT-era scale (BASELINE config #1 family)
+    "gpt2-small": _p(vocab_size=50304, hidden_size=768, intermediate_size=2048,
+                     num_layers=12, num_heads=12, max_seq_len=1024,
+                     tie_embeddings=True),
+    "bert-large-like": _p(vocab_size=30592, hidden_size=1024, intermediate_size=4096,
+                          num_layers=24, num_heads=16, max_seq_len=512),
+    # Llama-2 family (FastGen/ZeRO baselines; blogs/deepspeed-fastgen/README.md:135)
+    "llama2-1b": _p(vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+                    num_layers=16, num_heads=16, num_kv_heads=16, max_seq_len=4096),
+    "llama2-7b": _p(vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+                    num_layers=32, num_heads=32, num_kv_heads=32, max_seq_len=4096),
+    "llama2-13b": _p(vocab_size=32000, hidden_size=5120, intermediate_size=13824,
+                     num_layers=40, num_heads=40, num_kv_heads=40, max_seq_len=4096),
+    "llama2-70b": _p(vocab_size=32000, hidden_size=8192, intermediate_size=28672,
+                     num_layers=80, num_heads=64, num_kv_heads=8, max_seq_len=4096),
+    "mistral-7b": _p(vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+                     num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=8192),
+    "mixtral-8x7b": _p(vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+                       num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=8192,
+                       num_experts=8, num_experts_per_tok=2),
+}
+
+
+def get_config(name: str, **overrides) -> ModelConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown model preset {name!r}; have {sorted(PRESETS)}")
+    return replace(PRESETS[name], **overrides) if overrides else PRESETS[name]

@@ -1,0 +1,202 @@
+"""Transformer building blocks, written TPU-first.
+
+Functional (params-in, activations-out) equivalents of the reference's fused modules
+(``deepspeed/ops/transformer/inference/ds_attention.py``, ``ds_mlp.py``,
+``csrc/transformer/*``): on TPU the elementwise/norm fusion those CUDA kernels provide
+comes from XLA, so these are plain jnp compositions; the genuinely kernel-worthy op
+(attention over long sequences) dispatches through :func:`attention` to a Pallas flash
+kernel when on TPU (``ops/flash_attention.py``) and to an exact jnp reference elsewhere.
+
+Sharding: activations are annotated with logical axes via :func:`constrain` so the
+SPMD partitioner keeps batch over (data, fsdp), sequence over seq, and heads/ffn over
+model — the activation-layout contract TP/SP rest on.
+"""
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import ModelConfig
+
+Params = Dict[str, Any]
+
+
+# --------------------------------------------------------------------------- sharding
+def constrain(x: jnp.ndarray, *spec) -> jnp.ndarray:
+    """Best-effort ``with_sharding_constraint`` against the world topology.
+
+    No-op when no topology is installed (pure single-device use) or when the spec
+    doesn't apply (axis missing from the mesh). Model code stays mesh-agnostic.
+    """
+    from ..comm import topology as topo_mod
+
+    topo = topo_mod._WORLD_TOPOLOGY
+    if topo is None:
+        return x
+    try:
+        return jax.lax.with_sharding_constraint(x, topo.sharding(*spec))
+    except (ValueError, TypeError):
+        return x
+
+
+BATCH = ("data", "fsdp")  # input batch dim is split over both DP-ish axes
+
+
+# --------------------------------------------------------------------------- norm
+def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """RMSNorm (reference kernel: ``csrc/transformer/inference/csrc/rms_norm.cu``;
+    XLA fuses the reduction+rescale chain on TPU)."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps) * scale.astype(jnp.float32)).astype(dtype)
+
+
+# --------------------------------------------------------------------------- rope
+def rope_frequencies(head_dim: int, theta: float) -> np.ndarray:
+    return 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float32) / head_dim))
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
+    """Rotary embedding (reference kernel: ``csrc/transformer/inference/csrc/
+    apply_rotary_pos_emb.cu``). x: [B, S, H, D]; positions: [B, S] or [S]."""
+    head_dim = x.shape[-1]
+    freqs = jnp.asarray(rope_frequencies(head_dim, theta))
+    if positions.ndim == 1:
+        positions = positions[None, :]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B, S, 1, D/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+# --------------------------------------------------------------------------- attention
+def reference_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        causal: bool = True,
+                        segment_ids: Optional[jnp.ndarray] = None,
+                        kv_positions_below: Optional[jnp.ndarray] = None
+                        ) -> jnp.ndarray:
+    """Exact softmax attention in jnp — the parity reference for the Pallas kernels
+    (the role torch plays for the reference's kernel tests, SURVEY.md §4).
+
+    q: [B, Sq, H, D], k/v: [B, Skv, KVH, D]. GQA handled by head repetition.
+    ``kv_positions_below``: decode-mode masking — attend only to kv slots < this
+    per-query position (used with a prefilled KV cache where Sq << Skv).
+    """
+    b, sq, h, d = q.shape
+    kvh = k.shape[2]
+    if kvh != h:
+        rep = h // kvh
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / np.sqrt(d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    skv = k.shape[1]
+    mask = None
+    if kv_positions_below is not None:
+        kv_idx = jnp.arange(skv)[None, None, :]
+        mask = kv_idx < kv_positions_below[:, :, None]  # [B, Sq, Skv]
+        mask = mask[:, None, :, :]
+    elif causal:
+        qi = jnp.arange(sq)[:, None]
+        ki = jnp.arange(skv)[None, :]
+        mask = (ki <= qi + (skv - sq))[None, None, :, :]
+    if segment_ids is not None:
+        seg = (segment_ids[:, None, :, None] == segment_ids[:, None, None, :]) \
+            if segment_ids.shape[1] == sq and sq == skv else None
+        if seg is not None:
+            mask = seg if mask is None else jnp.logical_and(mask, seg)
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+              impl: str = "auto",
+              causal: bool = True,
+              segment_ids: Optional[jnp.ndarray] = None,
+              kv_positions_below: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Attention dispatch — the seam where Pallas/SP implementations plug in
+    (reference analog: the op-binding indirection of
+    ``ops/transformer/inference/op_binding/``)."""
+    if impl == "auto":
+        impl = "flash" if (jax.default_backend() == "tpu"
+                           and kv_positions_below is None) else "xla"
+    if impl == "flash":
+        from ..ops.flash_attention import flash_attention
+
+        try:
+            return flash_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+        except NotImplementedError:
+            impl = "xla"
+    if impl == "ring":
+        from ..parallel.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, causal=causal)
+    if impl == "ulysses":
+        from ..parallel.ulysses import ulysses_attention
+
+        return ulysses_attention(q, k, v, causal=causal)
+    return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids,
+                               kv_positions_below=kv_positions_below)
+
+
+# --------------------------------------------------------------------------- blocks
+def attention_block(p: Params, x: jnp.ndarray, cfg: ModelConfig,
+                    positions: jnp.ndarray,
+                    segment_ids: Optional[jnp.ndarray] = None,
+                    kv_cache: Optional[Tuple] = None,
+                    impl: Optional[str] = None):
+    """Self-attention sublayer: qkv proj → RoPE → attention → out proj.
+
+    With ``kv_cache=(k_cache, v_cache, write_pos)`` runs in decode mode: appends
+    current k/v at ``write_pos`` and attends over the cache (the role of the
+    reference's ``linear_blocked_kv_rotary`` + ``blocked_flash`` kernels,
+    ``inference/v2/kernels/ragged_ops/``). Returns (out, new_kv_cache).
+    """
+    b, s, d = x.shape
+    q = jnp.einsum("bsd,dq->bsq", x, p["wq"]).reshape(
+        b, s, cfg.num_heads, cfg.head_dim)
+    k = jnp.einsum("bsd,dk->bsk", x, p["wk"]).reshape(
+        b, s, cfg.num_kv_heads, cfg.head_dim)
+    v = jnp.einsum("bsd,dk->bsk", x, p["wv"]).reshape(
+        b, s, cfg.num_kv_heads, cfg.head_dim)
+    q = constrain(q, BATCH, "seq", "model", None)
+    k = constrain(k, BATCH, "seq", "model", None)
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+
+    new_cache = None
+    if kv_cache is not None:
+        k_cache, v_cache, write_pos = kv_cache
+        k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k, write_pos, axis=1)
+        v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v, write_pos, axis=1)
+        new_cache = (k_cache, v_cache, write_pos + s)
+        kv_below = positions + 1  # attend to everything at-or-before own position
+        out = attention(q, k_cache, v_cache, impl=impl or cfg.attn_impl,
+                        causal=False, kv_positions_below=kv_below)
+    else:
+        out = attention(q, k, v, impl=impl or cfg.attn_impl, causal=True,
+                        segment_ids=segment_ids)
+    out = out.reshape(b, s, cfg.q_dim)
+    out = jnp.einsum("bsq,qd->bsd", out, p["wo"])
+    return constrain(out, BATCH, "seq", None), new_cache
+
+
+def glu_mlp(p: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    """Gated-linear-unit MLP (SwiGLU/GeGLU). Reference fuses bias+activation in
+    ``csrc/transformer/inference/csrc/gelu.cu`` / v2 ``gated_activations``; XLA
+    fuses the same chain into the matmul epilogue on TPU."""
+    act = jax.nn.silu if cfg.activation == "silu" else jax.nn.gelu
+    gate = jnp.einsum("bsd,df->bsf", x, p["w_gate"])
+    up = jnp.einsum("bsd,df->bsf", x, p["w_up"])
+    h = act(gate) * up
+    h = constrain(h, BATCH, "seq", "model")
+    return jnp.einsum("bsf,fd->bsd", h, p["w_down"])

@@ -1,0 +1,3 @@
+"""TPU kernel library (Pallas) — the analog of the reference's ``csrc/`` +
+``deepspeed/ops`` native-op layer (SURVEY.md §2.5). Ops dispatch from the model/
+engine level and fall back to XLA-fused jnp references off-TPU."""

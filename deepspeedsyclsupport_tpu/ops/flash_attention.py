@@ -1,0 +1,17 @@
+"""Pallas flash attention (placeholder seam).
+
+Will hold the fused streaming-softmax attention kernel (reference analog:
+``csrc/transformer/inference/csrc/`` fused attention + ``evoformer_attn``;
+SURVEY.md §2.5 "TPU plan: Pallas flash-attention variants"). Until the kernel
+lands, raises NotImplementedError so ``models.layers.attention`` falls back to
+the exact jnp reference.
+"""
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    causal: bool = True,
+                    segment_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    raise NotImplementedError("pallas flash attention not yet built")

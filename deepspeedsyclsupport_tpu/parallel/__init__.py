@@ -1,0 +1,12 @@
+"""Parallelism strategies over the named mesh.
+
+TPU-native analogs of the reference's strategy layer (SURVEY.md §2.4):
+
+* :mod:`.moe` — expert parallel MoE (``deepspeed/moe/sharded_moe.py``)
+* :mod:`.ulysses` — Ulysses sequence parallel (``deepspeed/sequence/layer.py``)
+* :mod:`.ring_attention` — ring-attention context parallel (absent upstream; the
+  TPU-native long-context addition, SURVEY.md §2.4 CP row)
+* :mod:`.pipeline` — pipeline parallel 1F1B (``deepspeed/runtime/pipe/``)
+* :mod:`.tensor_parallel` — TP sharding-rule helpers (``module_inject/auto_tp.py``)
+"""
+from .moe import moe_mlp, topk_gating  # noqa: F401

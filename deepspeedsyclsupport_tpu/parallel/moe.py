@@ -1,0 +1,111 @@
+"""Mixture-of-Experts with expert parallelism over the ``expert`` mesh axis.
+
+TPU-native rebuild of ``deepspeed/moe/`` (SURVEY.md §2.4 EP row):
+
+* gating — ``TopKGate`` / ``top1gating`` / ``top2gating``
+  (``moe/sharded_moe.py:348,184,282``): router logits → top-k experts, capacity
+  truncation, load-balance aux loss ``E * Σ_e (mean_prob_e × token_frac_e)``.
+* dispatch — the reference routes tokens with an explicit ``_AllToAll`` autograd op
+  (``moe/sharded_moe.py:95``) between expert-parallel ranks. Here dispatch/combine
+  are einsums against a one-hot capacity layout; with experts sharded over the
+  ``expert`` axis and tokens over (data, fsdp), XLA lowers those einsums to exactly
+  the all-to-all pair over ICI — no hand-written comm.
+* expert compute — vmapped GLU over the expert dim (the grouped-GEMM the reference
+  gets from CUTLASS, ``inference/v2/.../cutlass_multi_gemm.py``; on TPU the batched
+  einsum hits the MXU directly).
+
+Shapes: T tokens, E experts, C capacity, D model, F ffn.
+"""
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.layers import constrain
+
+
+def topk_gating(logits: jnp.ndarray, k: int, capacity: int,
+                rng: Optional[jax.Array] = None,
+                jitter: float = 0.0) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Top-k gating with capacity (reference ``top1gating``/``top2gating``,
+    ``moe/sharded_moe.py:184,282``).
+
+    Returns (dispatch [T, E, C] one-hot, combine [T, E, C] weights, aux_loss).
+    """
+    t, e = logits.shape
+    if jitter > 0.0 and rng is not None:
+        logits = logits * jax.random.uniform(
+            rng, logits.shape, logits.dtype, 1.0 - jitter, 1.0 + jitter)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [T, E]
+
+    # top-k expert ids per token
+    _, expert_idx = jax.lax.top_k(probs, k)                       # [T, k]
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)     # [T, k, E]
+
+    # Load-balance aux loss (top2gating: uses the top-1 assignment fraction).
+    me = probs.mean(axis=0)                                       # [E]
+    ce = onehot[:, 0, :].mean(axis=0)                             # [E]
+    aux_loss = jnp.sum(me * ce) * e
+
+    # Position of each (token, choice) within its expert's capacity buffer.
+    # Flatten choices in priority order: all top-1 choices first (they win capacity
+    # slots over top-2 spill), matching the reference's top-2 ordering.
+    flat = onehot.transpose(1, 0, 2).reshape(k * t, e)            # [k*T, E]
+    pos_in_expert = jnp.cumsum(flat, axis=0) - flat               # [k*T, E]
+    within = (pos_in_expert < capacity)
+    flat = flat * within
+    pos = (pos_in_expert * flat).sum(axis=-1)                     # [k*T]
+    keep = flat.sum(axis=-1)                                      # [k*T] 0/1
+
+    gate_w = jnp.take_along_axis(probs, expert_idx, axis=1)       # [T, k]
+    gate_w = gate_w / jnp.maximum(gate_w.sum(axis=-1, keepdims=True), 1e-9)
+    gate_flat = gate_w.transpose(1, 0).reshape(k * t) * keep      # [k*T]
+
+    cap_onehot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                                dtype=jnp.float32)               # [k*T, C]
+    # [k*T, E, C] → sum over choices → [T, E, C]
+    dc = flat[:, :, None] * cap_onehot[:, None, :]
+    dispatch = dc.reshape(k, t, e, capacity).sum(axis=0)
+    combine = (gate_flat[:, None, None] * dc).reshape(
+        k, t, e, capacity).sum(axis=0)
+    return dispatch, combine, aux_loss
+
+
+def moe_mlp(p: Dict[str, Any], x: jnp.ndarray, cfg,
+            rng: Optional[jax.Array] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """MoE GLU block (reference ``MOELayer.forward``, ``moe/sharded_moe.py:425``).
+
+    x: [B, S, D] → (out [B, S, D], aux_loss scalar).
+    """
+    b, s, d = x.shape
+    t = b * s
+    e, k = cfg.num_experts, cfg.num_experts_per_tok
+    capacity = int(np.ceil(t * cfg.capacity_factor * k / e))
+    capacity = max(capacity, k)
+
+    xt = x.reshape(t, d)
+    logits = jnp.einsum("td,de->te", xt.astype(jnp.float32),
+                        p["router"].astype(jnp.float32))
+    dispatch, combine, aux = topk_gating(logits, k, capacity, rng,
+                                         cfg.router_jitter)
+
+    # dispatch → [E, C, D]; sharded over the expert axis so the einsum below is
+    # the all-to-all the reference implements by hand (_AllToAll, sharded_moe.py:95)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), xt)
+    expert_in = constrain(expert_in, "expert", None, None)
+
+    act = jax.nn.silu if cfg.activation == "silu" else jax.nn.gelu
+
+    def one_expert(w, h):  # h: [C, D]
+        gate = jnp.einsum("cd,df->cf", h, w["w_gate"])
+        up = jnp.einsum("cd,df->cf", h, w["w_up"])
+        return jnp.einsum("cf,fd->cd", act(gate) * up, w["w_down"])
+
+    expert_out = jax.vmap(one_expert)(
+        {"w_gate": p["w_gate"], "w_up": p["w_up"], "w_down": p["w_down"]},
+        expert_in)                                               # [E, C, D]
+    expert_out = constrain(expert_out, "expert", None, None)
+
+    out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
+    return out.reshape(b, s, d), aux.astype(jnp.float32)

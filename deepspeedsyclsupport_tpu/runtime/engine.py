@@ -172,6 +172,8 @@ class Engine:
         self.micro_steps = 0
         self._accum_grads = None
         self._accum_count = 0
+        self._accum_losses = []
+        self._pending_events = []  # buffered monitor samples (see _post_step)
         self._last_batch = None
         self._rng = jax.random.PRNGKey(self.config.seed)
         self.timers = SynchronizedWallClockTimer()
@@ -326,6 +328,7 @@ class Engine:
         else:
             self._accum_grads = jax.tree_util.tree_map(jnp.add, self._accum_grads,
                                                        grads)
+        self._accum_losses.append(loss_val)
         self._accum_count += 1
         self.micro_steps += 1
         self.timers(BACKWARD_GLOBAL_TIMER).stop()
@@ -353,14 +356,17 @@ class Engine:
         self.params, self.opt_state, self.scaler_state, metrics = self._apply_fn(
             self.params, self.opt_state, self.scaler_state, self._accum_grads,
             float(self._accum_count))
+        self.timers(STEP_GLOBAL_TIMER).stop()
+        metrics = dict(metrics)
+        if self._accum_losses:
+            # mean over the accumulation window (matches the fused path's
+            # losses.mean(), not just the last microbatch)
+            metrics["loss"] = jnp.stack(self._accum_losses).mean()
         self._accum_grads = None
         self._accum_count = 0
+        self._accum_losses = []
         self.global_steps += 1
-        metrics = dict(metrics)
-        if self.losses is not None:
-            metrics["loss"] = self.losses
         self._post_step(metrics)
-        self.timers(STEP_GLOBAL_TIMER).stop()
         return metrics
 
     # ================================================================ shared tail
@@ -381,20 +387,36 @@ class Engine:
                 f"loss={float(jax.device_get(loss)) if loss is not None else float('nan'):.4f} "
                 f"lr={self.get_lr():.3e} scale={self.get_loss_scale():.1f}")
         if self.monitor.enabled:
-            events = [("Train/Samples/train_loss",
-                       float(jax.device_get(metrics["loss"])),
-                       self.global_steps * self.config.train_batch_size)
-                      if "loss" in metrics else None,
-                      ("Train/Samples/lr", self.get_lr(),
-                       self.global_steps * self.config.train_batch_size)]
+            # Buffer device scalars; device_get only at print boundaries so the
+            # host never blocks on in-flight steps (reference gets the same
+            # overlap from CUDA streams).
+            samples = self.global_steps * self.config.train_batch_size
+            ev = [("Train/Samples/train_loss", metrics["loss"], samples)
+                  ] if "loss" in metrics else []
+            ev.append(("Train/Samples/lr", ("__lr__", self.global_steps), samples))
             if self.fp16_enabled:
-                events.append(("Train/Samples/loss_scale", self.get_loss_scale(),
-                               self.global_steps * self.config.train_batch_size))
-            self.monitor.write_events([e for e in events if e])
+                ev.append(("Train/Samples/loss_scale", metrics["loss_scale"],
+                           samples))
+            self._pending_events.extend(ev)
+            if self.global_steps % self.config.steps_per_print == 0:
+                self._flush_monitor()
         if self.config.wall_clock_breakdown and \
                 self.global_steps % self.config.steps_per_print == 0:
             self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
                              STEP_GLOBAL_TIMER])
+
+    def _flush_monitor(self):
+        events = []
+        for name, val, samples in self._pending_events:
+            if isinstance(val, tuple) and val[0] == "__lr__":
+                try:
+                    val = self.lr_schedule(val[1])
+                except TypeError:
+                    val = self.get_lr()
+            events.append((name, float(jax.device_get(val)), samples))
+        self._pending_events = []
+        if events:
+            self.monitor.write_events(events)
 
     # ================================================================ accessors
     @property
@@ -508,9 +530,11 @@ class Engine:
 
     # ================================================================ misc
     def eval_batch(self, batch):
+        """Loss on a batch WITHOUT touching training state (does not cache the
+        batch for backward(), unlike :meth:`forward`)."""
         if self._eval_fn is None:
-            self.forward(batch)
-            return self.losses
+            self._eval_fn = jax.jit(
+                lambda p, b, r: self._loss_and_metrics(p, b, r)[0])
         return self._eval_fn(self.params, batch,
                              jax.random.fold_in(self._rng, self.micro_steps))
 

@@ -69,26 +69,54 @@ def param_sharding(topo: MeshTopology, stage: int,
     """Build a ``leaf -> NamedSharding`` function for parameters.
 
     ``extra_rules(path, shape)`` may return a PartitionSpec to compose tensor
-    parallelism (TP specs win on their dims; fsdp takes a remaining dim).
+    parallelism (TP specs win on their dims; fsdp takes a remaining dim). Rules may
+    name ``fsdp`` explicitly to pin WHICH dim shards at stage 3 (e.g. keeping the
+    stacked-layer dim of a scanned model unsharded); below stage 3 those fsdp
+    entries are stripped, so one rule set serves all stages.
     """
     mesh = topo.mesh
     n = topo.axis_sizes["fsdp"]
 
+    def strip_axis(s, ax):
+        if isinstance(s, tuple):
+            t = tuple(a for a in s if a != ax)
+            return t if len(t) > 1 else (t[0] if t else None)
+        return None if s == ax else s
+
     def rule(path, leaf) -> NamedSharding:
         shape = np.shape(leaf)
-        tp_spec = list(extra_rules(path, shape)) if extra_rules else []
+        ruled = extra_rules(path, shape) if extra_rules else None
+        tp_spec = list(ruled) if ruled is not None else []
         tp_spec += [None] * (len(shape) - len(tp_spec))
+        if stage < 3:
+            tp_spec = [strip_axis(s, "fsdp") for s in tp_spec]
+        # each dim must divide by the PRODUCT of its named axis sizes; shed axes
+        # (fsdp first — TP layout is load-bearing, FSDP is only a memory saving)
+        # until it does
+        for i, s in enumerate(tp_spec):
+            def axes_of(sp):
+                return [a for a in (sp if isinstance(sp, tuple) else (sp,)) if a]
+
+            def divides(sp):
+                prod = math.prod(topo.axis_sizes.get(a, 1) for a in axes_of(sp))
+                return i < len(shape) and shape[i] % max(prod, 1) == 0
+
+            for ax in (["fsdp"] + axes_of(s)):
+                if divides(tp_spec[i]):
+                    break
+                tp_spec[i] = strip_axis(tp_spec[i], ax)
         if stage >= 3 and n > 1:
             used = {ax for s in tp_spec for ax in (s if isinstance(s, tuple) else (s,))
                     if ax}
-            free = [i for i, s in enumerate(tp_spec) if s is None]
-            # shard the largest free, divisible dim over fsdp
-            div = [i for i in free
-                   if shape[i] % n == 0] if "fsdp" not in used else []
-            size = math.prod(shape) if shape else 0
-            if div and size >= threshold:
-                i = max(div, key=lambda j: shape[j])
-                tp_spec[i] = "fsdp"
+            if "fsdp" not in used and math.prod(shape or (0,)) >= threshold:
+                # shard the largest free divisible dim over fsdp (choose_shard_dim
+                # policy restricted to dims the TP spec left free; 1 = taken
+                # sentinel, indivisible by n>1 and never the max)
+                free_shape = tuple(d if s is None else 1
+                                   for d, s in zip(shape, tp_spec))
+                i = choose_shard_dim(free_shape, n, threshold=0)
+                if i is not None:
+                    tp_spec[i] = "fsdp"
         return NamedSharding(mesh, PartitionSpec(*tp_spec))
 
     return rule

@@ -1,0 +1,136 @@
+"""Model-family tests (reference analog: tests/unit/model tests + kernel-parity
+pattern of SURVEY.md §4 — here decode-vs-full-forward parity and engine-driven
+loss-decrease on the tiny presets)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeedsyclsupport_tpu as ds
+from deepspeedsyclsupport_tpu.models import build_model, get_config
+
+
+def tiny_batch(rng, cfg, b=4, s=32):
+    ids = jax.random.randint(rng, (b, s), 0, cfg.vocab_size)
+    return {"input_ids": ids}
+
+
+def test_forward_shapes():
+    model = build_model("tiny")
+    params = model.init_params()
+    batch = tiny_batch(jax.random.PRNGKey(0), model.config)
+    logits = model.apply(params, batch["input_ids"])
+    assert logits.shape == (4, 32, model.config.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_loss_finite_and_near_uniform_at_init():
+    model = build_model("tiny")
+    params = model.init_params()
+    loss, metrics = model.loss(params, tiny_batch(jax.random.PRNGKey(1),
+                                                  model.config))
+    assert np.isfinite(float(loss))
+    # random init ≈ uniform over vocab
+    assert abs(float(loss) - np.log(model.config.vocab_size)) < 1.0
+
+
+def test_scan_and_loop_paths_agree():
+    cfg_scan = get_config("tiny")
+    cfg_loop = get_config("tiny", scan_layers=False)
+    m_scan, m_loop = build_model(cfg_scan), build_model(cfg_loop)
+    p_scan = m_scan.init_params(jax.random.PRNGKey(7))
+    # restack into per-layer list for the loop model
+    n = cfg_loop.num_layers
+    p_loop = dict(p_scan)
+    p_loop["layers"] = [
+        jax.tree_util.tree_map(lambda x: x[i], p_scan["layers"])
+        for i in range(n)]
+    ids = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, cfg_scan.vocab_size)
+    np.testing.assert_allclose(np.asarray(m_scan.apply(p_scan, ids)),
+                               np.asarray(m_loop.apply(p_loop, ids)),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_decode_matches_full_forward():
+    model = build_model("tiny", dtype="float32")
+    params = model.init_params()
+    ids = jax.random.randint(jax.random.PRNGKey(3), (2, 12), 0,
+                             model.config.vocab_size)
+    full = model.apply(params, ids)
+    cache = model.init_kv_cache(2, 32, dtype=jnp.float32)
+    # prefill first 8, then decode 4 one by one
+    logits_p, cache = model.decode_step(params, cache, ids[:, :8])
+    outs = [logits_p]
+    for i in range(8, 12):
+        l, cache = model.decode_step(params, cache, ids[:, i:i + 1])
+        outs.append(l)
+    inc = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(inc),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_moe_model_runs_and_has_aux_loss():
+    model = build_model("tiny-moe")
+    params = model.init_params()
+    loss, metrics = model.loss(params, tiny_batch(jax.random.PRNGKey(4),
+                                                  model.config))
+    assert np.isfinite(float(loss))
+    assert "moe_aux_loss" in metrics
+    assert float(metrics["moe_aux_loss"]) > 0.0
+
+
+def test_engine_trains_tiny_model(mesh8):
+    model = build_model("tiny")
+    config = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 3},
+        "bf16": {"enabled": False},
+        "steps_per_print": 100,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config, topology=mesh8)
+    rng = jax.random.PRNGKey(0)
+    batch = tiny_batch(rng, model.config, b=8, s=32)  # fixed batch → overfit
+    losses = [float(engine.train_batch(batch)["loss"]) for _ in range(10)]
+    assert losses[-1] < losses[0] - 0.5, losses
+
+
+def test_tp_sharding_rules_apply(mesh8):
+    pass  # superseded by test below
+
+
+def test_tp_fsdp_composed_shardings():
+    from deepspeedsyclsupport_tpu.comm.topology import build_topology
+    from deepspeedsyclsupport_tpu.runtime import zero as zero_lib
+
+    topo = build_topology(dp=2, fsdp=2, tp=2)
+    model = build_model("tiny")
+    params = model.init_params()
+    sh = zero_lib.tree_param_shardings(params, topo, stage=3,
+                                       extra_rules=model.sharding_rules)
+    flat = jax.tree_util.tree_flatten_with_path(sh)[0]
+    by_path = {jax.tree_util.keystr(kp): s for kp, s in flat}
+    wq = [s for p, s in by_path.items() if "wq" in p][0]
+    spec = wq.spec
+    assert spec[0] is None          # stacked layer dim never sharded
+    assert "model" in jax.tree_util.tree_leaves(list(spec))
+    # placement must actually work
+    placed = jax.device_put(jax.tree_util.tree_leaves(params)[0],
+                            jax.tree_util.tree_leaves(
+                                sh, is_leaf=lambda x: hasattr(x, "spec"))[0])
+    assert placed is not None
+
+
+def test_moe_engine_trains(mesh8):
+    model = build_model("tiny-moe")
+    config = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 100,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config, topology=mesh8)
+    batch = tiny_batch(jax.random.PRNGKey(0), model.config, b=8, s=32)
+    losses = [float(engine.train_batch(batch)["loss"]) for _ in range(8)]
+    assert losses[-1] < losses[0]
