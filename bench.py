@@ -1,0 +1,89 @@
+"""Headline benchmark — training tokens/sec/chip on the flagship Llama-family model.
+
+Runs on whatever single accelerator is present (driver: one real TPU v5e chip) and
+prints ONE JSON line. ``vs_baseline`` compares achieved model-FLOPs utilization to
+the reference's best published sustained utilization — DeepSpeed-Ulysses' 175
+TFLOPs/GPU on A100 = 54% of bf16 peak (``blogs/deepspeed-ulysses/README.md:82``,
+mirrored in BASELINE.md) — i.e. vs_baseline > 1 means we sustain a larger fraction
+of our chip's peak than the reference does of its chip's.
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeedsyclsupport_tpu as ds
+from deepspeedsyclsupport_tpu.models import build_model, get_config
+
+# bf16 peak FLOPs by platform (per chip)
+PEAKS = {"tpu": 197e12,   # TPU v5e
+         "cpu": 1e12}     # nominal, for smoke runs off-TPU
+REFERENCE_MFU = 0.54       # Ulysses 175/312 TFLOPs on A100 (BASELINE.md)
+
+
+def model_flops_per_token(cfg) -> float:
+    """6·N_active for the matmuls + attention quadratic term."""
+    n_active = cfg.param_count()
+    if cfg.num_experts > 0:
+        dense_mlp = 3 * cfg.hidden_size * cfg.intermediate_size * cfg.num_layers
+        n_active -= dense_mlp * (cfg.num_experts - cfg.num_experts_per_tok)
+    attn = 12 * cfg.num_layers * cfg.hidden_size  # ≈ per token at seq S: *S below
+    return 6 * n_active, attn
+
+
+def main():
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    if on_tpu:
+        name, seq, micro_bs, steps = "llama2-1b", 1024, 4, 8
+        cfg = get_config(name, remat=True, max_seq_len=seq)
+    else:
+        name, seq, micro_bs, steps = "tiny", 256, 8, 4
+        cfg = get_config(name)
+
+    model = build_model(cfg) if not isinstance(cfg, str) else build_model(name)
+    topo = ds.build_topology(dp=1)
+    config = {
+        "train_batch_size": micro_bs,
+        "train_micro_batch_size_per_gpu": micro_bs,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "steps_per_print": 10_000,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config, topology=topo)
+    batch = {"input_ids": jax.random.randint(jax.random.PRNGKey(0),
+                                             (micro_bs, seq), 0, cfg.vocab_size)}
+    # warmup/compile. NOTE: sync via value fetch (float), NOT block_until_ready —
+    # on the axon remote-TPU platform block_until_ready returns before the
+    # dispatch chain finishes; fetching the value is the reliable barrier.
+    for _ in range(2):
+        m = engine.train_batch(batch)
+    float(np.asarray(jax.device_get(m["loss"])))
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        m = engine.train_batch(batch)
+    float(np.asarray(jax.device_get(m["loss"])))
+    dt = time.perf_counter() - t0
+
+    tokens = steps * micro_bs * seq
+    tok_per_sec = tokens / dt
+    f_matmul, f_attn = model_flops_per_token(cfg)
+    flops_per_token = f_matmul + f_attn * seq
+    achieved = tok_per_sec * flops_per_token
+    mfu = achieved / PEAKS.get(platform, PEAKS["cpu"])
+    print(json.dumps({
+        "metric": f"train_tokens_per_sec_per_chip_{name}_seq{seq}",
+        "value": round(tok_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / REFERENCE_MFU, 4),
+        "detail": {"platform": platform, "mfu": round(mfu, 4),
+                   "tflops": round(achieved / 1e12, 2),
+                   "loss": round(float(np.asarray(m["loss"])), 4)},
+    }))
+
+
+if __name__ == "__main__":
+    main()
