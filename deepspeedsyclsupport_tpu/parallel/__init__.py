@@ -10,3 +10,6 @@ TPU-native analogs of the reference's strategy layer (SURVEY.md §2.4):
 * :mod:`.tensor_parallel` — TP sharding-rule helpers (``module_inject/auto_tp.py``)
 """
 from .moe import moe_mlp, topk_gating  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401
+from .tensor_parallel import auto_tp_rules, column_parallel, row_parallel  # noqa: F401
+from .ulysses import ulysses_attention  # noqa: F401

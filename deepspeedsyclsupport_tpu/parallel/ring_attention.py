@@ -1,0 +1,91 @@
+"""Ring attention — context parallelism over the ``seq`` ICI ring.
+
+ABSENT in the reference snapshot (SURVEY.md §2.4: "no ring-attention/context-
+parallel impl — worth adding natively; ring attention over the ICI ring is a TPU
+sweet spot"). This is the TPU-native long-context story alongside Ulysses: K/V
+blocks rotate around the ``seq`` mesh axis via ``ppermute`` while each device
+accumulates attention for its resident Q block with a streaming (online-softmax)
+update — memory O(S/n) per device, comm fully overlapped with the block matmuls.
+
+Math per incoming block (flash-attention accumulation):
+    s      = q·kᵀ/√d  (masked by absolute positions → causal across blocks)
+    m'     = max(m, rowmax(s))
+    acc    = acc·e^{m-m'} + e^{s-m'}·v
+    l      = l·e^{m-m'} + rowsum(e^{s-m'})
+    out    = acc / l    (after all n blocks)
+"""
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _ring_body(q, k, v, axis_name: str, causal: bool):
+    """shard_map body. q/k/v local: [B, C, H, D] (C = S / ring_size)."""
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, c, h, d = q.shape
+    kvh = k.shape[2]
+    if kvh != h:
+        rep = h // kvh
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / np.sqrt(d)
+    qf = q.astype(jnp.float32)
+    q_pos = idx * c + jnp.arange(c)
+
+    def step(t, carry):
+        k_t, v_t, acc, m, l = carry
+        # after t rotations device idx holds kv block (idx - t) mod n
+        src_blk = (idx - t) % n
+        kv_pos = src_blk * c + jnp.arange(c)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_t.astype(jnp.float32)) * scale
+        if causal:
+            mask = kv_pos[None, :] <= q_pos[:, None]          # [C, C]
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))                 # [B, H, C]
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])                      # [B, H, C, C]
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_t.astype(jnp.float32))
+        l = l * corr + p.sum(axis=-1)
+        # rotate kv to the next device on the ring (send up, recv from below)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_t = jax.lax.ppermute(k_t, axis_name, perm)
+        v_t = jax.lax.ppermute(v_t, axis_name, perm)
+        return k_t, v_t, acc, m_new, l
+
+    acc0 = jnp.zeros((b, h, c, d), jnp.float32)
+    m0 = jnp.full((b, h, c), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, c), jnp.float32)
+    _, _, acc, m, l = jax.lax.fori_loop(0, n, step, (k, v, acc0, m0, l0))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]               # [B, H, C, D]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)           # [B, C, H, D]
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   causal: bool = True,
+                   axis_name: str = "seq",
+                   topology=None) -> jnp.ndarray:
+    """q/k/v: [B, S, H|KVH, D] logically global, sequence-sharded over ``seq``."""
+    from ..comm.topology import get_world_topology
+
+    topo = topology or get_world_topology()
+    if topo.axis_sizes.get(axis_name, 1) <= 1:
+        from ..models.layers import reference_attention
+
+        return reference_attention(q, k, v, causal=causal)
+
+    spec = P(("data", "fsdp"), axis_name, "model", None)
+    fn = jax.shard_map(
+        partial(_ring_body, axis_name=axis_name, causal=causal),
+        mesh=topo.mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)

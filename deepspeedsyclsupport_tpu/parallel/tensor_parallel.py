@@ -1,0 +1,67 @@
+"""Tensor-parallel sharding helpers — the auto-TP analog.
+
+The reference's inference auto-TP (``deepspeed/module_inject/auto_tp.py:483``
+``AutoTP``) walks a torch module, pattern-detects Linears, and rewrites them into
+``LinearLayer`` (column-split) / ``LinearAllreduce`` (row-split + allreduce).
+On TPU the rewrite is unnecessary: TP is a *layout*, so auto-TP reduces to a rule
+that maps parameter names/shapes → PartitionSpecs; XLA inserts the collectives
+(the psum that ``LinearAllreduce`` hand-codes).
+
+``auto_tp_rules`` is that rule for arbitrary user pytrees: column-parallel for
+up-projections, row-parallel for down/output projections (recognized by the same
+name conventions AutoTP keys on: ``o_proj/down_proj/out_proj/dense_4h_to_h/wo``…),
+replicate everything else.
+"""
+from typing import Callable, Optional, Sequence, Tuple
+
+# Output/down projections → row-parallel (shard input dim; XLA adds the psum).
+# Mirrors AutoTP's allreduce-linear name list (auto_tp.py load policies).
+ROW_PARALLEL_PATTERNS: Tuple[str, ...] = (
+    "o_proj", "out_proj", "wo", "w_down", "down_proj", "dense_4h_to_h",
+    "attention.dense", "fc2", "w2", "proj_out",
+)
+# Embedding-style tables → shard vocab dim
+EMBEDDING_PATTERNS: Tuple[str, ...] = ("embed", "wte", "word_embeddings", "tok")
+
+
+def _path_str(path) -> str:
+    return "/".join(
+        str(getattr(k, "key", getattr(k, "name", k))) for k in path).lower()
+
+
+def auto_tp_rules(stacked_layer_key: Optional[str] = "layers",
+                  row_patterns: Sequence[str] = ROW_PARALLEL_PATTERNS,
+                  embed_patterns: Sequence[str] = EMBEDDING_PATTERNS
+                  ) -> Callable:
+    """Build an ``extra_rules(path, shape)`` callable for
+    ``runtime/zero.tree_param_shardings`` from name heuristics."""
+
+    def rules(path, shape):
+        s = _path_str(path)
+        ndim = len(shape)
+        if ndim < 2:
+            return None
+        stacked = stacked_layer_key is not None and stacked_layer_key in s
+        pre = (None,) if (stacked and ndim >= 3) else ()
+        body = ndim - len(pre)
+        if body < 2:
+            return None
+        if any(p in s for p in embed_patterns):
+            return pre + ("model",) + (None,) * (body - 1)
+        if any(p in s for p in row_patterns):
+            # row-parallel: shard the (first body) input dim, fsdp the output dim
+            return pre + ("model",) + ("fsdp",) + (None,) * (body - 2)
+        # default column-parallel: output (last) dim over model, fsdp an input dim
+        return pre + ("fsdp",) + (None,) * (body - 2) + ("model",)
+
+    return rules
+
+
+def column_parallel(*, stacked: bool = False) -> Tuple:
+    """Spec for a [in, out] weight split on out (Megatron ColumnParallelLinear)."""
+    return ((None,) if stacked else ()) + ("fsdp", "model")
+
+
+def row_parallel(*, stacked: bool = False) -> Tuple:
+    """Spec for a [in, out] weight split on in (Megatron RowParallelLinear)."""
+    return ((None,) if stacked else ()) + ("model", "fsdp")

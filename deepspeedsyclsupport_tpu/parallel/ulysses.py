@@ -1,0 +1,51 @@
+"""Ulysses sequence parallelism (DeepSpeed-Ulysses), TPU-native.
+
+The reference's ``DistributedAttention`` (``deepspeed/sequence/layer.py:60``) wraps
+any attention with two explicit all-to-alls over the sequence process group:
+scatter heads / gather sequence before local attention (``_SeqAllToAll:44``,
+``single_all_to_all:15``), and the inverse after. Here the same data movement is
+*declared*: activations arrive sequence-sharded ``[B, S/sp, H, D]``; re-constraining
+to head-sharded ``[B, S, H/(sp·tp), D]`` makes the SPMD partitioner emit exactly the
+all-to-all over the ``seq`` ICI axis, fused and overlapped by XLA — no hand-rolled
+autograd op, and the backward all-to-alls fall out of AD.
+
+Requirement (same as the reference, ``sequence/layer.py`` assert): total heads must
+be divisible by sp·tp.
+"""
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..models.layers import BATCH, constrain, reference_attention
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      causal: bool = True,
+                      segment_ids: Optional[jnp.ndarray] = None,
+                      inner: Optional[str] = None) -> jnp.ndarray:
+    """q: [B, S, H, D] (logically global; physically sequence-sharded over 'seq').
+
+    head-scatter/seq-gather → local attention (full sequence, head slice) →
+    seq-scatter/head-gather.
+    """
+    # incoming layout: sequence split over 'seq', heads split over 'model'
+    q = constrain(q, BATCH, "seq", "model", None)
+    k = constrain(k, BATCH, "seq", "model", None)
+    v = constrain(v, BATCH, "seq", "model", None)
+
+    # all-to-all #1: gather sequence, scatter heads over (model, seq)
+    q = constrain(q, BATCH, None, ("model", "seq"), None)
+    k = constrain(k, BATCH, None, ("model", "seq"), None)
+    v = constrain(v, BATCH, None, ("model", "seq"), None)
+
+    if inner == "flash":
+        from ..ops.flash_attention import flash_attention
+
+        out = flash_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+    else:
+        out = reference_attention(q, k, v, causal=causal,
+                                  segment_ids=segment_ids)
+
+    # all-to-all #2: back to sequence-sharded, heads gathered
+    out = constrain(out, BATCH, None, ("model", "seq"), None)
+    return constrain(out, BATCH, "seq", "model", None)
