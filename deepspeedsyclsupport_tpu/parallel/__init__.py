@@ -10,6 +10,9 @@ TPU-native analogs of the reference's strategy layer (SURVEY.md §2.4):
 * :mod:`.tensor_parallel` — TP sharding-rule helpers (``module_inject/auto_tp.py``)
 """
 from .moe import moe_mlp, topk_gating  # noqa: F401
+from .pipeline import (InferenceSchedule, PipelineModule,  # noqa: F401
+                       TrainSchedule, partition_balanced, partition_uniform,
+                       spmd_pipeline)
 from .ring_attention import ring_attention  # noqa: F401
 from .tensor_parallel import auto_tp_rules, column_parallel, row_parallel  # noqa: F401
 from .ulysses import ulysses_attention  # noqa: F401
