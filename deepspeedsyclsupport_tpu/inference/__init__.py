@@ -1,0 +1,11 @@
+"""Inference engines.
+
+TPU-native analogs of the reference inference stack (SURVEY.md §2.6):
+
+* :mod:`.engine` — v1-style engine (``deepspeed/inference/engine.py:39``):
+  TP-sharded model + jitted prefill/decode generate loop with a static KV cache.
+* :mod:`.v2` — FastGen analog (``deepspeed/inference/v2/``): paged KV cache,
+  ragged continuous batching, Dynamic-SplitFuse scheduling.
+"""
+from .config import DSTpuInferenceConfig  # noqa: F401
+from .engine import InferenceEngine, init_inference  # noqa: F401

@@ -1,0 +1,81 @@
+"""Inference configuration.
+
+Analog of ``DeepSpeedInferenceConfig`` (``deepspeed/inference/config.py``, 304 LoC):
+the same key families — dtype, tensor_parallel, generation limits — minus the knobs
+that only exist to steer CUDA kernel injection (``replace_with_kernel_inject``,
+``enable_cuda_graph``…), which are accepted and ignored so reference-style config
+dicts keep working (XLA jit-compiles and fuses unconditionally; there is nothing to
+inject or capture).
+"""
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+
+_IGNORED_KEYS = {
+    # CUDA-specific knobs with no TPU meaning; jit/XLA subsumes them.
+    "replace_with_kernel_inject", "enable_cuda_graph", "use_triton",
+    "triton_autotune", "cuda_graph_max_batch_size", "injection_policy",
+    "injection_policy_tuple", "replace_method", "moe_experts", "save_mp_checkpoint_path",
+}
+
+_DTYPES = {
+    "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16, "torch.bfloat16": jnp.bfloat16,
+    "fp16": jnp.float16, "half": jnp.float16, "torch.half": jnp.float16,
+    "float16": jnp.float16, "torch.float16": jnp.float16,
+    "fp32": jnp.float32, "float": jnp.float32, "float32": jnp.float32,
+    "torch.float32": jnp.float32,
+    "int8": jnp.int8,
+}
+
+
+@dataclass
+class TensorParallelConfig:
+    """Reference ``DeepSpeedTPConfig`` (``inference/config.py``)."""
+    tp_size: int = 1
+    enabled: bool = True
+
+
+@dataclass
+class DSTpuInferenceConfig:
+    dtype: Any = jnp.bfloat16
+    tensor_parallel: TensorParallelConfig = field(
+        default_factory=TensorParallelConfig)
+    max_out_tokens: int = 1024          # reference: max_out_tokens (clamps generate)
+    min_out_tokens: int = 1             # reference: min_out_tokens; a scheduler
+    # admission hint — enforced by the v2 ragged engine's can_schedule, not v1
+    max_seq_len: int = 2048             # prompt + generation KV budget
+    eos_token_id: Optional[int] = None
+    pad_token_id: int = 0
+    seed: int = 0
+
+    @classmethod
+    def from_config(cls, config: Optional[Dict[str, Any]] = None, **kw
+                    ) -> "DSTpuInferenceConfig":
+        cfg = dict(config or {})
+        cfg.update(kw)
+        for k in list(cfg):
+            if k in _IGNORED_KEYS:
+                cfg.pop(k)
+        tp = cfg.pop("tensor_parallel", None) or {}
+        if isinstance(tp, TensorParallelConfig):
+            tp_cfg = tp
+        else:
+            if isinstance(tp, int):
+                tp = {"tp_size": tp}
+            tp_cfg = TensorParallelConfig(**tp)
+        if "mp_size" in cfg:  # reference legacy alias
+            tp_cfg.tp_size = cfg.pop("mp_size")
+        dtype = cfg.pop("dtype", jnp.bfloat16)
+        if isinstance(dtype, str):
+            try:
+                dtype = _DTYPES[dtype.lower()]
+            except KeyError:
+                raise ValueError(
+                    f"unknown inference dtype {dtype!r}; one of "
+                    f"{sorted(_DTYPES)}") from None
+        known = {f for f in cls.__dataclass_fields__}
+        unknown = set(cfg) - known
+        if unknown:
+            raise ValueError(f"unknown inference config keys: {sorted(unknown)}")
+        return cls(dtype=dtype, tensor_parallel=tp_cfg, **cfg)

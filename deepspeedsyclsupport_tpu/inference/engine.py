@@ -1,0 +1,187 @@
+"""Inference engine v1 — TP-sharded model with a jitted generate loop.
+
+Analog of ``InferenceEngine`` / ``deepspeed.init_inference``
+(``deepspeed/inference/engine.py:39``, ``deepspeed/__init__.py:269``). The
+reference's jobs and their TPU-native forms:
+
+==============================================  =================================
+reference (CUDA/torch)                          here (JAX/XLA)
+==============================================  =================================
+kernel injection (``replace_transformer_layer``  nothing to inject: the framework
+``module_inject/replace_module.py:182``)         owns the model (``models/``) and
+                                                 XLA fuses what the CUDA kernels
+                                                 hand-fused
+auto-TP weight surgery (``auto_tp.py``,          TP is declarative: the model's
+``LinearAllreduce`` per-layer allreduce)         ``sharding_rules`` + GSPMD insert
+                                                 the identical collectives
+CUDA-graph capture (``engine.py`` graph path)    ``jax.jit`` — the whole decode
+                                                 step is one compiled program
+KV cache inside kernel workspace                 explicit ``KVCache`` pytree,
+(``inference_context.h``)                        sharded over the mesh
+HF ``generate`` driving per-token forwards       ``lax.scan`` decode loop compiled
+                                                 once (host never in the loop)
+==============================================  =================================
+
+Ragged batches are right-padded; correctness under padding comes from explicit
+slot-validity masks (see :meth:`InferenceEngine._generate_fn`), the same masking
+contract the v2 ragged engine gets from its atom builder.
+"""
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import DSTpuInferenceConfig
+from .sampling import SamplingParams, sample_token
+from ..comm.topology import MeshTopology, build_topology
+from ..runtime import zero as zero_lib
+from ..utils.logging import log_dist
+
+
+def init_inference(model: Any = None,
+                   params: Any = None,
+                   config: Optional[Dict] = None,
+                   **kwargs) -> "InferenceEngine":
+    """Reference ``deepspeed.init_inference`` (``deepspeed/__init__.py:269``).
+
+    ``model``: a ``models.CausalLM`` (or any object with ``_forward``-style
+    ``apply/decode_step/init_kv_cache/sharding_rules``). ``params``: its pytree
+    (host or device). kwargs merge into config (reference allows both styles).
+    """
+    cfg = DSTpuInferenceConfig.from_config(config, **kwargs)
+    if model is None:
+        raise ValueError("init_inference needs a model")
+    if params is None:
+        if not hasattr(model, "init_params"):
+            raise ValueError("provide params, or a model with init_params()")
+        params = model.init_params()
+    return InferenceEngine(model, params, cfg)
+
+
+class InferenceEngine:
+    def __init__(self, model: Any, params: Any, config: DSTpuInferenceConfig,
+                 topology: Optional[MeshTopology] = None):
+        self.module = model
+        self.config = config
+        tp = config.tensor_parallel.tp_size if config.tensor_parallel.enabled else 1
+        # serving mesh: TP innermost, leftover devices become batch ("data") ranks
+        self.topology = topology or build_topology(dp=-1, tp=tp)
+
+        # --------------------------------------------------- weight placement
+        # stage-0 placement + the model's TP rules = auto-TP without surgery
+        # (reference: AutoTP row/col sharding, module_inject/auto_tp.py:483)
+        rules = getattr(model, "sharding_rules", None)
+        self.param_shardings = zero_lib.tree_param_shardings(
+            params, self.topology, stage=0, extra_rules=rules)
+        dtype = config.dtype
+        self.params = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(
+                jnp.asarray(x).astype(dtype)
+                if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else
+                jnp.asarray(x), s),
+            params, self.param_shardings)
+        log_dist(f"inference engine: tp={tp}, dtype={jnp.dtype(dtype).name}, "
+                 f"mesh={self.topology.axis_sizes}")
+
+        self._forward_fn = None
+        self._generate_fns: Dict[Tuple, Callable] = {}
+        self._rng = jax.random.PRNGKey(config.seed)
+
+    # ------------------------------------------------------------------ forward
+    def forward(self, input_ids: jnp.ndarray) -> jnp.ndarray:
+        """Full-sequence logits (reference ``InferenceEngine.forward``)."""
+        if self._forward_fn is None:
+            self._forward_fn = jax.jit(self.module.apply)
+        return self._forward_fn(self.params, input_ids)
+
+    __call__ = forward
+
+    # ----------------------------------------------------------------- generate
+    def generate(self,
+                 input_ids: jnp.ndarray,
+                 prompt_lens: Optional[jnp.ndarray] = None,
+                 max_new_tokens: int = 32,
+                 do_sample: bool = False,
+                 temperature: float = 1.0,
+                 top_k: int = 0,
+                 top_p: float = 1.0,
+                 eos_token_id: Optional[int] = None,
+                 rng: Optional[jax.Array] = None) -> jnp.ndarray:
+        """Autoregressive generation (the role HF ``generate`` plays over the
+        reference engine; here one jitted prefill + ``lax.scan`` decode).
+
+        ``input_ids``: [B, S] right-padded prompts; ``prompt_lens``: [B] true
+        lengths (defaults to S for all). Returns [B, max_new_tokens] generated
+        ids, post-EOS positions filled with ``pad_token_id``.
+        """
+        b, s = input_ids.shape
+        if prompt_lens is None:
+            prompt_lens = jnp.full((b,), s, jnp.int32)
+        eos = eos_token_id if eos_token_id is not None else self.config.eos_token_id
+        # generation limits (reference: max_out_tokens / max input+output budget,
+        # inference/config.py + inference_context workspace sizing)
+        max_new_tokens = min(int(max_new_tokens), self.config.max_out_tokens)
+        if s + max_new_tokens > self.config.max_seq_len:
+            raise ValueError(
+                f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds "
+                f"max_seq_len ({self.config.max_seq_len}); raise max_seq_len "
+                f"in the inference config")
+        sp = SamplingParams(do_sample, float(temperature), int(top_k),
+                            float(top_p))
+        key = (s, int(max_new_tokens), sp, -1 if eos is None else int(eos))
+        if key not in self._generate_fns:
+            self._generate_fns[key] = jax.jit(partial(
+                self._generate_fn, max_new_tokens=int(max_new_tokens), sp=sp,
+                eos_id=-1 if eos is None else int(eos)))
+        if rng is None:
+            self._rng, rng = jax.random.split(self._rng)
+        return self._generate_fns[key](
+            self.params, jnp.asarray(input_ids), jnp.asarray(prompt_lens,
+                                                             jnp.int32), rng)
+
+    def _generate_fn(self, params, input_ids, prompt_lens, rng, *,
+                     max_new_tokens: int, sp: SamplingParams, eos_id: int):
+        """Prefill + decode under one jit.
+
+        KV layout: slots [0, S) hold the (right-padded) prompt — pad slots are
+        garbage, masked out; slots [S, S+t] hold generated tokens, shared across
+        the batch. Slot-validity mask per sequence i at decode step t:
+        ``slot < prompt_lens[i]  or  S <= slot <= S+t``. RoPE positions stay
+        *logical* (``prompt_lens[i] + t``), so padding never shifts phases.
+        """
+        model = self.module
+        b, s = input_ids.shape
+        max_len = s + max_new_tokens
+        pad_id = self.config.pad_token_id
+
+        cache = model.init_kv_cache(b, max_len, dtype=self.config.dtype)
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        logits, cache = model.decode_step(params, cache, input_ids,
+                                          positions=positions)
+        last = jnp.take_along_axis(
+            logits, (prompt_lens - 1)[:, None, None], axis=1)[:, 0]  # [B, V]
+        rng, sub = jax.random.split(rng)
+        tok0 = sample_token(last, sub, sp)
+        done0 = (tok0 == eos_id) if eos_id >= 0 else jnp.zeros((b,), bool)
+        slots = jnp.arange(max_len)
+
+        def step(carry, _):
+            cache, tok, done, key = carry
+            t = cache.write_pos - s  # decode step index (0-based)
+            pos = (prompt_lens + t)[:, None]
+            kv_mask = (slots[None, :] < prompt_lens[:, None]) | \
+                      ((slots >= s) & (slots <= s + t))[None, :]
+            logits, cache = model.decode_step(params, cache, tok[:, None],
+                                              positions=pos, kv_mask=kv_mask)
+            key, sub = jax.random.split(key)
+            nxt = sample_token(logits[:, 0], sub, sp)
+            if eos_id >= 0:
+                nxt = jnp.where(done, pad_id, nxt)
+                done = done | (nxt == eos_id)
+            return (cache, nxt, done, key), tok
+
+        (_, _, _, _), toks = jax.lax.scan(
+            step, (cache, tok0, done0, rng), None, length=max_new_tokens)
+        return jnp.swapaxes(toks, 0, 1)  # [B, max_new_tokens]

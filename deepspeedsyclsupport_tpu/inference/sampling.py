@@ -1,0 +1,38 @@
+"""Token sampling — greedy, temperature, top-k, top-p (nucleus).
+
+The reference scatters sampling across HF ``generate`` (it never owns the sampler;
+``inference/engine.py`` wraps the HF module). The TPU engine owns its jitted decode
+loop, so the sampler lives here as pure jnp — one function usable under ``lax.scan``.
+"""
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class SamplingParams(NamedTuple):
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0          # 0 = disabled
+    top_p: float = 1.0      # 1.0 = disabled
+
+
+def sample_token(logits: jnp.ndarray, rng: Optional[jax.Array],
+                 params: SamplingParams) -> jnp.ndarray:
+    """logits [B, V] → token ids [B] (int32)."""
+    if not params.do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / jnp.maximum(params.temperature, 1e-6)
+    if params.top_k and params.top_k > 0:
+        kth = jax.lax.top_k(logits, params.top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if params.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative mass >= top_p (always >= 1 tok)
+        keep = cum - probs < params.top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
+                         keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

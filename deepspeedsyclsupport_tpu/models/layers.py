@@ -78,14 +78,17 @@ def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndar
 def reference_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                         causal: bool = True,
                         segment_ids: Optional[jnp.ndarray] = None,
-                        kv_positions_below: Optional[jnp.ndarray] = None
-                        ) -> jnp.ndarray:
+                        kv_positions_below: Optional[jnp.ndarray] = None,
+                        kv_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Exact softmax attention in jnp — the parity reference for the Pallas kernels
     (the role torch plays for the reference's kernel tests, SURVEY.md §4).
 
     q: [B, Sq, H, D], k/v: [B, Skv, KVH, D]. GQA handled by head repetition.
     ``kv_positions_below``: decode-mode masking — attend only to kv slots < this
     per-query position (used with a prefilled KV cache where Sq << Skv).
+    ``kv_mask``: [B, Skv] explicit slot-validity mask, ANDed in — needed when
+    cache slot index ≠ token position (right-padded ragged batches, where pad
+    slots sit between each prompt's end and the shared decode region).
     """
     b, sq, h, d = q.shape
     kvh = k.shape[2]
@@ -111,6 +114,9 @@ def reference_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             if segment_ids.shape[1] == sq and sq == skv else None
         if seg is not None:
             mask = seg if mask is None else jnp.logical_and(mask, seg)
+    if kv_mask is not None:
+        m = kv_mask[:, None, None, :]  # [B, 1, 1, Skv]
+        mask = m if mask is None else jnp.logical_and(mask, m)
     if mask is not None:
         logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1)
@@ -122,13 +128,15 @@ def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
               impl: str = "auto",
               causal: bool = True,
               segment_ids: Optional[jnp.ndarray] = None,
-              kv_positions_below: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+              kv_positions_below: Optional[jnp.ndarray] = None,
+              kv_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Attention dispatch — the seam where Pallas/SP implementations plug in
     (reference analog: the op-binding indirection of
     ``ops/transformer/inference/op_binding/``)."""
     if impl == "auto":
         impl = "flash" if (jax.default_backend() == "tpu"
-                           and kv_positions_below is None) else "xla"
+                           and kv_positions_below is None
+                           and kv_mask is None) else "xla"
     if impl == "flash":
         from ..ops.flash_attention import flash_attention
 
@@ -145,7 +153,8 @@ def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
         return ulysses_attention(q, k, v, causal=causal)
     return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids,
-                               kv_positions_below=kv_positions_below)
+                               kv_positions_below=kv_positions_below,
+                               kv_mask=kv_mask)
 
 
 # --------------------------------------------------------------------------- blocks
@@ -153,7 +162,8 @@ def attention_block(p: Params, x: jnp.ndarray, cfg: ModelConfig,
                     positions: jnp.ndarray,
                     segment_ids: Optional[jnp.ndarray] = None,
                     kv_cache: Optional[Tuple] = None,
-                    impl: Optional[str] = None):
+                    impl: Optional[str] = None,
+                    kv_mask: Optional[jnp.ndarray] = None):
     """Self-attention sublayer: qkv proj → RoPE → attention → out proj.
 
     With ``kv_cache=(k_cache, v_cache, write_pos)`` runs in decode mode: appends
@@ -179,9 +189,16 @@ def attention_block(p: Params, x: jnp.ndarray, cfg: ModelConfig,
         k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k, write_pos, axis=1)
         v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v, write_pos, axis=1)
         new_cache = (k_cache, v_cache, write_pos + s)
-        kv_below = positions + 1  # attend to everything at-or-before own position
+        if kv_mask is not None:
+            # ragged right-padded batches: slot != position, so causality must
+            # be slot-space — query i of this chunk (written at write_pos+i)
+            # sees slots <= write_pos+i; kv_mask supplies validity of the rest
+            kv_below = write_pos + jnp.arange(s)[None, :] + 1
+        else:
+            kv_below = positions + 1  # slot == position: at-or-before own pos
         out = attention(q, k_cache, v_cache, impl=impl or cfg.attn_impl,
-                        causal=False, kv_positions_below=kv_below)
+                        causal=False, kv_positions_below=kv_below,
+                        kv_mask=kv_mask)
     else:
         out = attention(q, k, v, impl=impl or cfg.attn_impl, causal=True,
                         segment_ids=segment_ids)

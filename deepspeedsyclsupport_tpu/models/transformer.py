@@ -106,12 +106,13 @@ class CausalLM:
 
     # ------------------------------------------------------------------ forward
     def _layer(self, p: Params, x: jnp.ndarray, positions, segment_ids,
-               cache_slice, rng) -> Tuple[jnp.ndarray, Any, jnp.ndarray]:
+               cache_slice, rng, kv_mask=None
+               ) -> Tuple[jnp.ndarray, Any, jnp.ndarray]:
         cfg = self.config
         dtype = x.dtype  # pin activation dtype: fp32 params must not promote bf16
         h, new_cache = attention_block(
             p["attn"], rms_norm(x, p["attn_norm"]["scale"], cfg.rms_norm_eps),
-            cfg, positions, segment_ids, cache_slice)
+            cfg, positions, segment_ids, cache_slice, kv_mask=kv_mask)
         x = (x + h).astype(dtype)
         y = rms_norm(x, p["mlp_norm"]["scale"], cfg.rms_norm_eps)
         if cfg.any_moe:
@@ -126,7 +127,8 @@ class CausalLM:
                  positions: Optional[jnp.ndarray] = None,
                  segment_ids: Optional[jnp.ndarray] = None,
                  cache: Optional[KVCache] = None,
-                 rng: Optional[jax.Array] = None
+                 rng: Optional[jax.Array] = None,
+                 kv_mask: Optional[jnp.ndarray] = None
                  ) -> Tuple[jnp.ndarray, Optional[KVCache], jnp.ndarray]:
         """Returns (logits [B,S,V] fp32, new_cache, total_aux_loss)."""
         cfg = self.config
@@ -146,7 +148,7 @@ class CausalLM:
             if cache is not None:
                 cache_slice = (ck, cv, cache.write_pos)
             x, new_c, aux = self._layer(p, x, positions, segment_ids,
-                                        cache_slice, rng_l)
+                                        cache_slice, rng_l, kv_mask=kv_mask)
             nck, ncv = (new_c[0], new_c[1]) if new_c is not None else (ck, cv)
             return x, nck, ncv, aux
 
@@ -242,10 +244,16 @@ class CausalLM:
                        jnp.zeros((), jnp.int32))
 
     def decode_step(self, params: Params, cache: KVCache,
-                    tokens: jnp.ndarray) -> Tuple[jnp.ndarray, KVCache]:
+                    tokens: jnp.ndarray,
+                    positions: Optional[jnp.ndarray] = None,
+                    kv_mask: Optional[jnp.ndarray] = None
+                    ) -> Tuple[jnp.ndarray, KVCache]:
         """One incremental step over ``tokens`` [B, S] (S=1 for pure decode,
-        larger for prefill/chunked-prefill). Returns (logits [B, S, V], cache)."""
-        logits, new_cache, _ = self._forward(params, tokens, cache=cache)
+        larger for prefill/chunked-prefill). Returns (logits [B, S, V], cache).
+        ``positions``/``kv_mask`` support ragged right-padded batches (see
+        ``inference/engine.py``)."""
+        logits, new_cache, _ = self._forward(params, tokens, positions=positions,
+                                             cache=cache, kv_mask=kv_mask)
         return logits, new_cache
 
     # ------------------------------------------------------------------ sharding
