@@ -34,9 +34,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .config import DSTpuInferenceConfig
+from .params import place_inference_params
 from .sampling import SamplingParams, sample_token
 from ..comm.topology import MeshTopology, build_topology
-from ..runtime import zero as zero_lib
 from ..utils.logging import log_dist
 
 
@@ -73,15 +73,9 @@ class InferenceEngine:
         # stage-0 placement + the model's TP rules = auto-TP without surgery
         # (reference: AutoTP row/col sharding, module_inject/auto_tp.py:483)
         rules = getattr(model, "sharding_rules", None)
-        self.param_shardings = zero_lib.tree_param_shardings(
-            params, self.topology, stage=0, extra_rules=rules)
         dtype = config.dtype
-        self.params = jax.tree_util.tree_map(
-            lambda x, s: jax.device_put(
-                jnp.asarray(x).astype(dtype)
-                if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else
-                jnp.asarray(x), s),
-            params, self.param_shardings)
+        self.params, self.param_shardings = place_inference_params(
+            params, self.topology, rules, dtype)
         log_dist(f"inference engine: tp={tp}, dtype={jnp.dtype(dtype).name}, "
                  f"mesh={self.topology.axis_sizes}")
 

@@ -1,0 +1,28 @@
+"""Shared inference weight placement.
+
+Both engines (v1 ``engine.py``, v2 ``engine_v2.py``) place weights the same way:
+stage-0 (replicate-unless-ruled) shardings composed with the model's declarative
+TP rules — the whole of the reference's auto-TP weight surgery
+(``module_inject/auto_tp.py``) — then cast floating leaves to the serving dtype.
+"""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..comm.topology import MeshTopology
+from ..runtime import zero as zero_lib
+
+
+def place_inference_params(params: Any, topology: MeshTopology, rules, dtype):
+    """Returns (placed_params, shardings)."""
+    shardings = zero_lib.tree_param_shardings(
+        params, topology, stage=0, extra_rules=rules)
+
+    def place(x, s):
+        x = jnp.asarray(x)
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(dtype)
+        return jax.device_put(x, s)
+
+    return jax.tree_util.tree_map(place, params, shardings), shardings

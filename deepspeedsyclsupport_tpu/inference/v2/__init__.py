@@ -1,0 +1,19 @@
+"""FastGen-class ragged serving engine (reference: ``deepspeed/inference/v2/``).
+
+Continuous batching with a paged (blocked) KV cache and Dynamic-SplitFuse token
+scheduling:
+
+* :mod:`.config` — engine knobs (``inference/v2/ragged/manager_configs.py``)
+* :mod:`.ragged` — ``BlockedAllocator`` free-list, sequence descriptors, and the
+  host-built ragged batch metadata (``inference/v2/ragged/``)
+* :mod:`.kv_cache` — blocked KV arrays on device (``ragged/kv_cache.py``)
+* :mod:`.model` — ragged forward over the paged cache (the role of the CUDA
+  ``ragged_ops`` kernel set: ``linear_blocked_kv_rotary``, ``blocked_flash``,
+  ``logits_gather``)
+* :mod:`.scheduler` — Dynamic SplitFuse token-budget scheduler
+* :mod:`.engine_v2` — ``InferenceEngineV2`` with the ``put/query/flush/
+  can_schedule`` contract (``inference/v2/engine_v2.py:107-237``)
+"""
+from .config import RaggedInferenceConfig  # noqa: F401
+from .engine_v2 import InferenceEngineV2  # noqa: F401
+from .ragged import BlockedAllocator, RaggedBatch, SequenceDescriptor  # noqa: F401

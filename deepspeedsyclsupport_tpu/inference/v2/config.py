@@ -1,0 +1,49 @@
+"""Ragged engine configuration.
+
+Analog of ``DSStateManagerConfig`` / ``RaggedInferenceEngineConfig``
+(``inference/v2/ragged/manager_configs.py``): the same knob families — KV block
+geometry, ragged batch budgets, sequence limits.
+"""
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+
+
+@dataclass
+class RaggedInferenceConfig:
+    block_size: int = 64            # KV tokens per block (reference KV_BLOCK_SIZE)
+    max_tokens_per_batch: int = 768  # SplitFuse token budget (max_ragged_batch_size)
+    max_sequences: int = 64         # concurrent seqs per forward (max_ragged_sequence_count)
+    max_context: int = 2048         # per-sequence KV budget (max_context)
+    num_blocks: Optional[int] = None  # total KV pool; default sized for half the
+    # worst case (continuous batching overcommits, like the reference's
+    # memory_config-driven cache sizing)
+    dtype: Any = jnp.bfloat16
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.num_blocks is None:
+            per_seq = math.ceil(self.max_context / self.block_size)
+            self.num_blocks = max(per_seq, self.max_sequences * per_seq // 2)
+        if self.max_context % self.block_size:
+            raise ValueError("max_context must be a multiple of block_size")
+
+    @property
+    def blocks_per_seq(self) -> int:
+        return self.max_context // self.block_size
+
+    @classmethod
+    def from_config(cls, config: Optional[Dict] = None, **kw):
+        cfg = dict(config or {})
+        cfg.update(kw)
+        if isinstance(cfg.get("dtype"), str):
+            from ..config import _DTYPES
+
+            cfg["dtype"] = _DTYPES[cfg["dtype"].lower()]
+        known = set(cls.__dataclass_fields__)
+        unknown = set(cfg) - known
+        if unknown:
+            raise ValueError(f"unknown ragged config keys: {sorted(unknown)}")
+        return cls(**cfg)

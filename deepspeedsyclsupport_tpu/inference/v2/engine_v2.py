@@ -1,0 +1,215 @@
+"""InferenceEngineV2 — continuous-batching ragged serving.
+
+Analog of ``InferenceEngineV2`` (``inference/v2/engine_v2.py``): the same
+``put / query / flush / can_schedule`` contract over a paged KV cache, plus a
+:meth:`generate` convenience loop that plays the role MII's serving loop plays
+above the reference engine.
+
+Data flow per :meth:`put` (reference ``engine_v2.py:107`` → §3.5 call stack):
+host scheduler picks chunks → ``RaggedBatch`` metadata built and shipped →
+ONE jitted ragged forward (QKV+RoPE+paged-append, blocked attention, MLP,
+logits gather) → last-token logits land back in each sequence descriptor.
+"""
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import RaggedInferenceConfig
+from .kv_cache import init_blocked_kv
+from .model import build_ragged_forward_fn
+from .ragged import BlockedAllocator, SequenceDescriptor, build_ragged_batch
+from .scheduler import schedule_chunks
+from ..params import place_inference_params
+from ..sampling import SamplingParams, sample_token
+from ...comm.topology import MeshTopology, build_topology
+from ...utils.logging import log_dist
+
+
+class InferenceEngineV2:
+    def __init__(self, model, params, config: Optional[dict] = None,
+                 topology: Optional[MeshTopology] = None, **kw):
+        self.config = (config if isinstance(config, RaggedInferenceConfig)
+                       else RaggedInferenceConfig.from_config(config, **kw))
+        cfg = self.config
+        self.model = model
+        self.topology = topology or build_topology(dp=-1)
+
+        rules = getattr(model, "sharding_rules", None)
+        self.params, _ = place_inference_params(params, self.topology, rules,
+                                                cfg.dtype)
+
+        self.kv = init_blocked_kv(model.config, cfg)
+        self.allocator = BlockedAllocator(cfg.num_blocks)
+        self.seqs: Dict[int, SequenceDescriptor] = {}
+        self._forward = build_ragged_forward_fn(model, cfg.block_size)
+        self._rng = jax.random.PRNGKey(cfg.seed)
+        self._sample_fn = jax.jit(sample_token, static_argnums=(2,))
+        log_dist(f"ragged engine: {cfg.num_blocks} KV blocks × {cfg.block_size} "
+                 f"tokens, budget {cfg.max_tokens_per_batch} tok/fwd, "
+                 f"≤{cfg.max_sequences} seqs")
+
+    # ------------------------------------------------------------- scheduling
+    def can_schedule(self, uids: Sequence[int],
+                     lengths: Sequence[int]) -> bool:
+        """Admission check (reference ``can_schedule:179``): sequence slots,
+        per-seq context limit, and worst-case KV block pressure."""
+        cfg = self.config
+        new = [u for u in uids if u not in self.seqs]
+        if len(self.seqs) + len(new) > cfg.max_sequences:
+            return False
+        want_blocks = 0
+        for u, n in zip(uids, lengths):
+            d = self.seqs.get(u)
+            cached = d.n_cached if d else 0
+            have = len(d.blocks) if d else 0
+            if cached + n > cfg.max_context:
+                return False
+            want_blocks += max(0, -(-(cached + n) // cfg.block_size) - have)
+        return want_blocks <= self.allocator.free_blocks
+
+    # -------------------------------------------------------------------- put
+    def put(self, uids: Sequence[int],
+            tokens_list: Sequence[Sequence[int]]) -> Dict[int, np.ndarray]:
+        """Enqueue tokens and run ONE ragged forward over what fits.
+
+        Returns {uid: last-token logits [V]} for sequences whose pending input
+        fully drained this pass (reference returns logits the same way; partial
+        prompt chunks stay pending for the next put)."""
+        cfg = self.config
+        if not self.can_schedule(uids, [len(t) for t in tokens_list]):
+            raise RuntimeError(
+                "cannot schedule batch: over sequence/context/KV limits "
+                "(check can_schedule first, as MII's scheduler does)")
+        for uid, toks in zip(uids, tokens_list):
+            d = self.seqs.get(uid)
+            if d is None:
+                d = self.seqs[uid] = SequenceDescriptor(uid=uid)
+            d.pending.extend(int(t) for t in toks)
+            d.last_logits = None
+
+        out: Dict[int, np.ndarray] = {}
+        while True:
+            chunks = schedule_chunks(
+                list(self.seqs.values()), self.allocator,
+                max_tokens=cfg.max_tokens_per_batch,
+                max_sequences=cfg.max_sequences, block_size=cfg.block_size,
+                max_context=cfg.max_context)
+            if not chunks:
+                break
+            logits = self._run(chunks)
+            for slot, (d, n) in enumerate(chunks):
+                del d.pending[:n]
+                d.n_cached += n
+                if not d.pending:
+                    d.last_logits = logits[slot]
+                    out[d.uid] = d.last_logits
+            if all(not d.pending for d in self.seqs.values()):
+                break
+        return out
+
+    def _run(self, chunks) -> np.ndarray:
+        cfg = self.config
+        batch = build_ragged_batch(chunks, cfg.max_tokens_per_batch,
+                                   cfg.max_sequences, cfg.blocks_per_seq)
+        logits, self.kv = self._forward(
+            self.params, self.kv, jnp.asarray(batch.tokens),
+            jnp.asarray(batch.token_seq), jnp.asarray(batch.token_pos),
+            jnp.asarray(batch.block_tables), jnp.asarray(batch.last_tok_idx))
+        return np.asarray(logits[:len(chunks)])
+
+    # ------------------------------------------------------------ query/flush
+    def query(self, uid: int) -> Optional[np.ndarray]:
+        """Last-token logits if the uid's input has drained (reference
+        ``query:153``)."""
+        d = self.seqs.get(uid)
+        return None if d is None else d.last_logits
+
+    def flush(self, uids: Sequence[int]) -> None:
+        """Release sequences and their KV blocks (reference ``flush:228``)."""
+        for uid in uids:
+            d = self.seqs.pop(uid, None)
+            if d is not None:
+                self.allocator.free(d.blocks)
+
+    # --------------------------------------------------------------- generate
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: int = 32,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0,
+                 eos_token_id: Optional[int] = None,
+                 rng: Optional[jax.Array] = None) -> List[List[int]]:
+        """Continuous-batching loop (the MII role above the reference engine).
+
+        Each iteration issues ONE fused put: every drained sequence's next
+        decode token plus as many waiting prompts as FIFO admission allows —
+        the SplitFuse fusion the scheduler is built for. Sequences retire on
+        EOS, length, or the context cap (truncation, not failure); under KV
+        pressure the longest-context sequence is evicted so decode always
+        progresses.
+        """
+        cfg = self.config
+        sp = SamplingParams(do_sample, float(temperature), int(top_k),
+                            float(top_p))
+        if rng is None:
+            self._rng, rng = jax.random.split(self._rng)
+        for p in prompts:
+            if len(p) > cfg.max_context:
+                raise ValueError(f"prompt of {len(p)} tokens can never fit "
+                                 f"max_context {cfg.max_context}")
+        results: Dict[int, List[int]] = {i: [] for i in range(len(prompts))}
+        waiting = [(i, list(p)) for i, p in enumerate(prompts) if p]
+        running: Dict[int, int] = {}  # uid -> remaining new-token budget
+        uid_base = 1 << 20  # avoid colliding with caller uids in shared engines
+
+        while waiting or running:
+            # 1. one batched sample over every drained sequence
+            put_uids: List[int] = []
+            put_toks: List[List[int]] = []
+            drained = [(u, self.query(u)) for u in list(running)]
+            drained = [(u, lg) for u, lg in drained if lg is not None]
+            if drained:
+                rng, sub = jax.random.split(rng)
+                toks = np.asarray(self._sample_fn(
+                    jnp.asarray(np.stack([lg for _, lg in drained])), sub, sp))
+                for (uid, _), tok in zip(drained, toks):
+                    tok = int(tok)
+                    results[uid - uid_base].append(tok)
+                    running[uid] -= 1
+                    done = (running[uid] <= 0
+                            or (eos_token_id is not None and tok == eos_token_id)
+                            or self.seqs[uid].n_cached >= cfg.max_context)
+                    if done:  # context-capped seqs truncate, not crash
+                        del running[uid]
+                        self.flush([uid])
+                    else:
+                        put_uids.append(uid)
+                        put_toks.append([tok])
+            # 2. KV pressure: evict longest-context decodes until the rest fit
+            while put_uids and not self.can_schedule(put_uids,
+                                                     [1] * len(put_uids)):
+                k = max(range(len(put_uids)),
+                        key=lambda i: self.seqs[put_uids[i]].n_cached)
+                uid = put_uids.pop(k)
+                put_toks.pop(k)
+                del running[uid]
+                self.flush([uid])
+            # 3. FIFO admission, fused into the SAME put as the decode tokens
+            while waiting:
+                idx, ptoks = waiting[0]
+                cand_u = put_uids + [uid_base + idx]
+                cand_t = put_toks + [ptoks]
+                if not self.can_schedule(cand_u, [len(t) for t in cand_t]):
+                    break
+                waiting.pop(0)
+                put_uids, put_toks = cand_u, cand_t
+                running[uid_base + idx] = max_new_tokens
+            if not put_uids:
+                if not running and waiting:
+                    raise RuntimeError(
+                        "nothing schedulable on an empty engine — prompts "
+                        "exceed KV pool limits; raise num_blocks/max_context")
+                continue
+            self.put(put_uids, put_toks)
+        return [results[i] for i in range(len(prompts))]

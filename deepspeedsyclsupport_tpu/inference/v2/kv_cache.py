@@ -1,0 +1,28 @@
+"""Blocked (paged) KV cache on device.
+
+Analog of ``BlockedKVCache`` (``inference/v2/ragged/kv_cache.py``): a pool of
+fixed-size KV blocks; sequences own arbitrary block lists, indirected through
+block tables. Layout [L, num_blocks * block_size, KVH, D] — flat slot axis so
+(de)referencing a slot is ``block_id * block_size + offset`` with one gather /
+scatter, which XLA lowers to efficient dynamic-slice traffic on TPU.
+"""
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from .config import RaggedInferenceConfig
+
+
+class BlockedKV(NamedTuple):
+    k: jnp.ndarray  # [L, num_blocks*block_size, KVH, D]
+    v: jnp.ndarray
+
+    @property
+    def num_slots(self) -> int:
+        return self.k.shape[1]
+
+
+def init_blocked_kv(model_config, cfg: RaggedInferenceConfig) -> BlockedKV:
+    shape = (model_config.num_layers, cfg.num_blocks * cfg.block_size,
+             model_config.num_kv_heads, model_config.head_dim)
+    return BlockedKV(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))

@@ -1,0 +1,137 @@
+"""Ragged forward over the paged KV cache.
+
+The compute core of the v2 engine — the role of the reference's CUDA ragged
+kernel set (``inference/v2/kernels/ragged_ops/``):
+
+* ``linear_blocked_kv_rotary`` — fused QKV + RoPE + paged-KV append → here the
+  qkv einsums + :func:`apply_rope` + one scatter into the flat slot axis.
+* ``blocked_flash`` (attention over ragged atoms) → :func:`_paged_attention`,
+  an exact XLA implementation gathering each slot's block-table-resolved KV.
+  (A Pallas blocked-flash variant is the planned fast path; this is the
+  correctness reference the kernel will be tested against, the same
+  kernel-vs-reference pattern the CUDA tests use, SURVEY.md §4.)
+* ``logits_gather`` — only each sequence's last scheduled token reaches the
+  unembedding matmul (``engine_v2.py`` forward tail).
+
+Operates on ONE flat token stream [T] with per-token (seq-slot, position)
+routing — batch composition never changes the compiled program.
+
+Reuses the training model's parameters and sublayer math (``models/layers.py``)
+— the weight-sharing the reference needs separate inference containers for.
+"""
+from functools import partial
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .kv_cache import BlockedKV
+from ...models.layers import apply_rope, glu_mlp, rms_norm
+
+
+def _paged_attention(q, k_cache, v_cache, token_seq, token_pos, block_tables,
+                     block_size: int):
+    """q: [T, H, D]; caches: [num_slots, KVH, D] (flat slot axis);
+    block_tables: [S, Bps]. Returns [T, H, D].
+
+    Each token's query attends to its sequence's KV at positions <= its own.
+    Per-sequence KV is materialized by resolving the block table to flat slot
+    ids and gathering — O(S · max_ctx) memory, the XLA-correctness baseline the
+    Pallas kernel will replace with true block-sparse streaming.
+    """
+    t, h, d = q.shape
+    s, bps = block_tables.shape
+    max_ctx = bps * block_size
+    kvh = k_cache.shape[1]
+
+    # seq-relative position j lives in flat slot table[j // bs] * bs + j % bs
+    j = jnp.arange(max_ctx)
+    slot_of_pos = block_tables[:, j // block_size] * block_size + (j % block_size)
+    k_seq = k_cache[slot_of_pos]  # [S, max_ctx, KVH, D]
+    v_seq = v_cache[slot_of_pos]
+
+    seq_clip = jnp.minimum(token_seq, s - 1)  # padded tokens: any valid row
+    k_tok = k_seq[seq_clip]  # [T, max_ctx, KVH, D]
+    v_tok = v_seq[seq_clip]
+    if kvh != h:
+        rep = h // kvh
+        k_tok = jnp.repeat(k_tok, rep, axis=2)
+        v_tok = jnp.repeat(v_tok, rep, axis=2)
+
+    scale = 1.0 / np.sqrt(d)
+    logits = jnp.einsum("thd,tchd->thc", q.astype(jnp.float32),
+                        k_tok.astype(jnp.float32)) * scale
+    mask = (j[None, :] <= token_pos[:, None])[:, None, :]  # causal over own seq
+    logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("thc,tchd->thd", probs, v_tok.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def ragged_forward(model, params: Any, kv: BlockedKV, tokens, token_seq,
+                   token_pos, block_tables, last_tok_idx, *, block_size: int
+                   ) -> Tuple[jnp.ndarray, BlockedKV]:
+    """Flat-token forward. Returns (per-slot last-token logits [S, V], new kv).
+
+    ``model``: a ``models.CausalLM`` — its stacked-layer params drive a
+    ``lax.scan`` here exactly as in training (``models/transformer.py``).
+    """
+    cfg = model.config
+    assert cfg.scan_layers, "ragged engine requires scan_layers param layout"
+    assert not cfg.any_moe, (
+        "MoE ragged serving not yet wired (use the v1 engine); reference "
+        "moe_scatter/moe_gather analog tracked in SURVEY.md §7 phase 7")
+    bs = block_size
+    num_slots = kv.num_slots
+    t = tokens.shape[0]
+    s = block_tables.shape[0]
+
+    pad = token_seq >= s  # padding sentinel from RaggedBatch
+    # flat destination slot per token; padded tokens scatter out-of-range (drop)
+    dest_block = block_tables[jnp.minimum(token_seq, s - 1),
+                              token_pos // bs]
+    dest = jnp.where(pad, num_slots, dest_block * bs + token_pos % bs)
+
+    x = jnp.take(params["embed"]["embedding"], tokens, axis=0)
+    x = x.astype(jnp.dtype(cfg.dtype))
+
+    def layer(x, inp):
+        p, k_cache, v_cache = inp
+        y = rms_norm(x, p["attn_norm"]["scale"], cfg.rms_norm_eps)
+        q = jnp.einsum("td,dq->tq", y, p["attn"]["wq"]).reshape(
+            t, cfg.num_heads, cfg.head_dim)
+        k = jnp.einsum("td,dk->tk", y, p["attn"]["wk"]).reshape(
+            t, cfg.num_kv_heads, cfg.head_dim)
+        v = jnp.einsum("td,dk->tk", y, p["attn"]["wv"]).reshape(
+            t, cfg.num_kv_heads, cfg.head_dim)
+        # RoPE in [B=1, S=T] layout
+        q = apply_rope(q[None], token_pos[None], cfg.rope_theta)[0]
+        k = apply_rope(k[None], token_pos[None], cfg.rope_theta)[0]
+        k_cache = k_cache.at[dest].set(k.astype(k_cache.dtype), mode="drop")
+        v_cache = v_cache.at[dest].set(v.astype(v_cache.dtype), mode="drop")
+        attn = _paged_attention(q, k_cache, v_cache, token_seq, token_pos,
+                                block_tables, bs)
+        x = (x + jnp.einsum("tq,qd->td", attn.reshape(t, cfg.q_dim),
+                            p["attn"]["wo"])).astype(x.dtype)
+        y2 = rms_norm(x, p["mlp_norm"]["scale"], cfg.rms_norm_eps)
+        h = glu_mlp(p["mlp"], y2[None], cfg)[0]
+        return (x + h).astype(x.dtype), (k_cache, v_cache)
+
+    x, (nk, nv) = jax.lax.scan(layer, x, (params["layers"], kv.k, kv.v))
+
+    x = rms_norm(x, params["final_norm"]["scale"], cfg.rms_norm_eps)
+    h_last = x[last_tok_idx]  # [S, d] — logits_gather
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("sd,vd->sv", h_last,
+                            params["embed"]["embedding"].astype(h_last.dtype))
+    else:
+        logits = jnp.einsum("sd,dv->sv", h_last,
+                            params["lm_head"]["kernel"].astype(h_last.dtype))
+    return logits.astype(jnp.float32), BlockedKV(nk, nv)
+
+
+def build_ragged_forward_fn(model, block_size: int):
+    """Jitted, shape-stable forward (compiled once per engine)."""
+    fn = partial(ragged_forward, model, block_size=block_size)
+    return jax.jit(fn, donate_argnums=(1,))

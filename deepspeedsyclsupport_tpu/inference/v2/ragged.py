@@ -1,0 +1,126 @@
+"""Ragged-batch state: block allocator, sequence descriptors, batch metadata.
+
+Analogs of the reference's ``inference/v2/ragged/`` host-side machinery:
+
+* :class:`BlockedAllocator` — ``ragged/blocked_allocator.py`` free-list of KV
+  blocks (there a torch int32 linked list; here a plain Python free list — this
+  is host bookkeeping, never on device).
+* :class:`SequenceDescriptor` — ``ragged/sequence_descriptor.py``
+  (``DSSequenceDescriptor``): tokens seen/scheduled, owned KV blocks.
+* :class:`RaggedBatch` — ``ragged/ragged_wrapper.py`` (``RaggedBatchWrapper``):
+  the per-forward metadata arrays, built once on host and shipped to device as
+  one transfer (the reference stages the same arrays into pinned host buffers).
+
+Static shapes: every array is padded to (max_tokens, max_sequences,
+blocks_per_seq) so ONE compiled XLA program serves every batch composition —
+the TPU equivalent of the reference building variable-size batches eagerly.
+"""
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class BlockedAllocator:
+    """KV block free-list (reference ``ragged/blocked_allocator.py``)."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError("need at least one block")
+        self._free: List[int] = list(range(num_blocks))
+        self.num_blocks = num_blocks
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def allocate(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise RuntimeError(
+                f"KV cache exhausted: want {n} blocks, {len(self._free)} free")
+        out, self._free = self._free[:n], self._free[n:]
+        return out
+
+    def free(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            if not 0 <= b < self.num_blocks:
+                raise ValueError(f"freeing invalid block {b}")
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+        self._free.extend(blocks)
+
+
+@dataclass(eq=False)  # identity semantics: descriptors live in scheduler sets
+class SequenceDescriptor:
+    """Per-sequence serving state (reference ``DSSequenceDescriptor``)."""
+
+    uid: int
+    pending: List[int] = field(default_factory=list)  # tokens awaiting forward
+    n_cached: int = 0                                 # tokens with KV in cache
+    blocks: List[int] = field(default_factory=list)   # owned KV block ids
+    last_logits: Optional[np.ndarray] = None          # set when pending drains
+
+    @property
+    def needs_tokens(self) -> int:
+        return len(self.pending)
+
+    def blocks_needed(self, new_tokens: int, block_size: int) -> int:
+        total = self.n_cached + new_tokens
+        want = -(-total // block_size)  # ceil
+        return max(0, want - len(self.blocks))
+
+
+@dataclass
+class RaggedBatch:
+    """One forward's metadata (reference ``RaggedBatchWrapper``): flat token
+    stream + per-token routing + per-sequence block tables. All padded."""
+
+    tokens: np.ndarray        # [T] int32
+    token_seq: np.ndarray     # [T] int32, slot id; padded entries = max_sequences
+    token_pos: np.ndarray     # [T] int32 position within sequence
+    block_tables: np.ndarray  # [S, blocks_per_seq] int32
+    last_tok_idx: np.ndarray  # [S] int32 index into tokens of each slot's last chunk token
+    seq_active: np.ndarray    # [S] bool
+    uids: List[int]           # slot -> uid (host only)
+
+    @property
+    def current_tokens(self) -> int:
+        return int((self.token_seq < len(self.seq_active)).sum())
+
+
+def build_ragged_batch(chunks: Sequence[Tuple[SequenceDescriptor, int]],
+                       max_tokens: int, max_sequences: int,
+                       blocks_per_seq: int) -> RaggedBatch:
+    """Assemble metadata for scheduled ``(descriptor, n_tokens)`` chunks.
+
+    The chunk's tokens are ``desc.pending[:n_tokens]``; positions continue from
+    ``desc.n_cached``. Mirrors ``RaggedBatchWrapper.insert_sequence`` +
+    ``finalize``.
+    """
+    if len(chunks) > max_sequences:
+        raise ValueError(f"{len(chunks)} chunks > max_sequences {max_sequences}")
+    T, S = max_tokens, max_sequences
+    tokens = np.zeros((T,), np.int32)
+    token_seq = np.full((T,), S, np.int32)   # S = padding sentinel
+    token_pos = np.zeros((T,), np.int32)
+    block_tables = np.zeros((S, blocks_per_seq), np.int32)
+    last_tok = np.zeros((S,), np.int32)
+    active = np.zeros((S,), bool)
+    uids: List[int] = []
+
+    cursor = 0
+    for slot, (desc, n) in enumerate(chunks):
+        assert n >= 1 and n <= len(desc.pending)
+        if cursor + n > T:
+            raise ValueError("token budget overflow — scheduler bug")
+        tokens[cursor:cursor + n] = desc.pending[:n]
+        token_seq[cursor:cursor + n] = slot
+        token_pos[cursor:cursor + n] = np.arange(desc.n_cached,
+                                                 desc.n_cached + n)
+        block_tables[slot, :len(desc.blocks)] = desc.blocks
+        last_tok[slot] = cursor + n - 1
+        active[slot] = True
+        uids.append(desc.uid)
+        cursor += n
+    return RaggedBatch(tokens, token_seq, token_pos, block_tables, last_tok,
+                       active, uids)

@@ -1,0 +1,67 @@
+"""Dynamic SplitFuse token-budget scheduler.
+
+The reference's scheduling contract lives half in ``InferenceEngineV2.put/
+can_schedule`` (``inference/v2/engine_v2.py:107,179``) and half in MII's
+ragged batch scheduler; the policy (from the FastGen blog,
+``blogs/deepspeed-fastgen/README.md``) is Dynamic SplitFuse:
+
+* decode tokens (1 per running sequence) are never starved — they ship in every
+  forward;
+* long prompts are SPLIT into chunks of at most the remaining token budget;
+* short prompts are FUSED together to fill the budget exactly, so every forward
+  runs at a near-constant, throughput-optimal token count.
+"""
+from typing import List, Sequence, Tuple
+
+from .ragged import BlockedAllocator, SequenceDescriptor
+
+
+def schedule_chunks(seqs: Sequence[SequenceDescriptor],
+                    allocator: BlockedAllocator,
+                    *, max_tokens: int, max_sequences: int, block_size: int,
+                    max_context: int
+                    ) -> List[Tuple[SequenceDescriptor, int]]:
+    """Pick ``(sequence, n_tokens)`` chunks for one forward.
+
+    Decode-phase sequences (pending == 1, already cached context) are admitted
+    first; prompt-phase sequences then split/fuse into the remaining budget.
+    Block allocation happens here so a chunk is only admitted if its KV fits
+    (the ``can_schedule`` KV-pressure check, ``engine_v2.py:179``).
+    """
+    chunks: List[Tuple[SequenceDescriptor, int]] = []
+    budget = max_tokens
+
+    decode = [d for d in seqs if d.needs_tokens == 1 and d.n_cached > 0]
+    prefill = [d for d in seqs if d.needs_tokens > 0 and d not in decode]
+
+    for d in decode:
+        if budget < 1 or len(chunks) >= max_sequences:
+            break
+        if not _admit(d, 1, allocator, block_size, max_context):
+            continue
+        chunks.append((d, 1))
+        budget -= 1
+
+    for d in prefill:
+        if budget < 1 or len(chunks) >= max_sequences:
+            break
+        n = min(d.needs_tokens, budget)
+        if d.n_cached + n > max_context:
+            n = max_context - d.n_cached
+            if n < 1:
+                continue  # out of context budget; caller decides eviction
+        if not _admit(d, n, allocator, block_size, max_context):
+            continue
+        chunks.append((d, n))
+        budget -= n
+    return chunks
+
+
+def _admit(d: SequenceDescriptor, n: int, allocator: BlockedAllocator,
+           block_size: int, max_context: int) -> bool:
+    want = d.blocks_needed(n, block_size)
+    if want > allocator.free_blocks:
+        return False
+    if want:
+        d.blocks.extend(allocator.allocate(want))
+    return True
