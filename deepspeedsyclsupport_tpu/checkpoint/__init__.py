@@ -1,1 +1,5 @@
-from .engine import load_tree, save_tree
+from .engine import load_tree, save_tree  # noqa: F401
+from .universal import DSTpuCheckpoint, load_state_dict  # noqa: F401
+from .zero_to_fp32 import (  # noqa: F401
+    convert_zero_checkpoint_to_fp32_state_dict,
+    get_fp32_state_dict_from_zero_checkpoint)

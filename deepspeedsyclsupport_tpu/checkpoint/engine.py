@@ -30,9 +30,29 @@ DATA_FILE = "state.bin"
 STATE_DIR = "state"  # orbax subdir
 
 
+def _key_str(k) -> str:
+    """Human-stable path segment: dict key / index / attr name, no brackets."""
+    if isinstance(k, jax.tree_util.DictKey):
+        return str(k.key)
+    if isinstance(k, jax.tree_util.SequenceKey):
+        return str(k.idx)
+    if isinstance(k, jax.tree_util.GetAttrKey):
+        return k.name
+    if isinstance(k, jax.tree_util.FlattenedIndexKey):
+        return str(k.key)
+    return str(k)
+
+
 def _leaf_paths(tree):
     flat, _ = jax.tree_util.tree_flatten_with_path(tree)
-    return ["/".join(str(k) for k in path) for path, _ in flat]
+    return ["/".join(_key_str(k) for k in path) for path, _ in flat]
+
+
+def _legacy_name(name: str) -> str:
+    """Clean name → the bracketed repr older checkpoints stored
+    (``str(DictKey)`` = ``['key']``, ``str(SequenceKey)`` = ``[idx]``)."""
+    return "/".join(f"[{s}]" if s.isdigit() else f"['{s}']"
+                    for s in name.split("/"))
 
 
 def save_tree(path: str, state: Dict[str, Any], meta: Dict[str, Any]) -> None:
@@ -104,7 +124,11 @@ def _load_native(path: str, example, shardings):
     with open(os.path.join(path, DATA_FILE), "rb") as f:
         for name, ex, sh in zip(names, ex_leaves, sh_leaves):
             if name not in by_name:
-                raise KeyError(f"checkpoint missing leaf {name!r}")
+                legacy = _legacy_name(name)  # pre-_key_str bracketed format
+                if legacy in by_name:
+                    name = legacy
+                else:
+                    raise KeyError(f"checkpoint missing leaf {name!r}")
             e = by_name[name]
             f.seek(e["offset"])
             arr = np.frombuffer(f.read(e["nbytes"]),

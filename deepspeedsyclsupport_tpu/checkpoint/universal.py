@@ -1,0 +1,105 @@
+"""Universal checkpoint: inspection + topology-free export.
+
+Reference machinery (``deepspeed/checkpoint/``, 1460 LoC):
+``ds_to_universal.py`` merges per-rank ZeRO shards and TP slices into
+per-parameter canonical files so a run can resume on a different topology;
+``deepspeed_checkpoint.py`` (``DeepSpeedCheckpoint``) inspects sharded
+checkpoint directories; ``universal_checkpoint.py`` hooks the resharded load.
+
+Here the storage format is ALREADY canonical — ``checkpoint/engine.py`` writes
+whole logical arrays and reshards on load against the caller's mesh — so the
+conversion step vanishes. What remains useful and is provided:
+
+* :class:`DSTpuCheckpoint` — inspector: leaf names/shapes/dtypes + run metadata
+  without loading arrays (reads the JSON index only).
+* :func:`load_state_dict` — pull any subset of leaves as host numpy arrays
+  (the "extract_zero_shard_files + merge" path collapsed to a file read).
+"""
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .engine import DATA_FILE, INDEX_FILE, META_FILE
+
+
+class DSTpuCheckpoint:
+    """Inspect a checkpoint directory (reference ``DeepSpeedCheckpoint``,
+    ``deepspeed/checkpoint/deepspeed_checkpoint.py``)."""
+
+    def __init__(self, ckpt_dir: str, tag: Optional[str] = None):
+        if tag is None:
+            latest = os.path.join(ckpt_dir, "latest")
+            if os.path.exists(latest):
+                with open(latest) as f:
+                    tag = f.read().strip()
+        self.dir = os.path.join(ckpt_dir, tag) if tag else ckpt_dir
+        index_path = os.path.join(self.dir, INDEX_FILE)
+        if not os.path.exists(index_path):
+            raise FileNotFoundError(
+                f"no {INDEX_FILE} under {self.dir} — not a dstpu checkpoint "
+                f"(multi-host orbax checkpoints carry their own metadata)")
+        with open(index_path) as f:
+            self.index: List[dict] = json.load(f)
+        self._by_name = {e["name"]: e for e in self.index}
+        meta_path = os.path.join(self.dir, META_FILE)
+        self.meta: Dict = {}
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                self.meta = json.load(f)
+        self._data = None  # lazily opened data-file handle
+
+    def leaf_names(self, prefix: str = "") -> List[str]:
+        return [e["name"] for e in self.index if e["name"].startswith(prefix)]
+
+    def shape(self, name: str):
+        return tuple(self._by_name[name]["shape"])
+
+    def dtype(self, name: str) -> str:
+        return self._by_name[name]["dtype"]
+
+    @property
+    def global_steps(self) -> int:
+        return int(self.meta.get("global_steps", 0))
+
+    @property
+    def zero_stage(self) -> int:
+        return int(self.meta.get("config", {}).get("zero_stage", 0))
+
+    def num_parameters(self, prefix: str = "params") -> int:
+        return sum(int(np.prod(e["shape"]))
+                   for e in self.index if e["name"].startswith(prefix))
+
+    def read(self, name: str) -> np.ndarray:
+        e = self._by_name[name]
+        if self._data is None:  # one open + OS page cache for all reads
+            self._data = open(os.path.join(self.dir, DATA_FILE), "rb")
+        self._data.seek(e["offset"])
+        buf = self._data.read(e["nbytes"])
+        return np.frombuffer(buf, dtype=np.dtype(e["dtype"])).reshape(e["shape"])
+
+    def close(self):
+        if self._data is not None:
+            self._data.close()
+            self._data = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+    def __del__(self):  # best-effort
+        self.close()
+
+
+def load_state_dict(ckpt_dir: str, tag: Optional[str] = None,
+                    prefix: str = "params",
+                    names: Optional[Sequence[str]] = None
+                    ) -> Dict[str, np.ndarray]:
+    """Flat {leaf-name: array} for a checkpoint subset — the universal,
+    topology-free view every converter/exporter builds on."""
+    with DSTpuCheckpoint(ckpt_dir, tag) as ck:
+        wanted = list(names) if names is not None else ck.leaf_names(prefix)
+        return {n: ck.read(n) for n in wanted}

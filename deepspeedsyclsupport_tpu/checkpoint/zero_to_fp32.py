@@ -1,0 +1,68 @@
+"""Export checkpoint weights as an fp32 state dict (torch-compatible).
+
+Analog of ``deepspeed/utils/zero_to_fp32.py`` (592 LoC): the reference walks
+per-DP-rank ZeRO shard files, reassembles flat partitions, and emits a
+``pytorch_model.bin``. Our shards reassemble at save time (the native format
+stores whole logical arrays), so export is: read leaves → upcast fp32 →
+``torch.save`` (torch-cpu is a baked-in dependency; falls back to ``.npz``
+without it).
+
+CLI parity: ``python -m deepspeedsyclsupport_tpu.checkpoint.zero_to_fp32
+<checkpoint_dir> <output_file>``.
+"""
+import argparse
+import os
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .universal import load_state_dict
+
+
+def get_fp32_state_dict_from_zero_checkpoint(
+        ckpt_dir: str, tag: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Reference ``get_fp32_state_dict_from_zero_checkpoint``: flat
+    {param-path: fp32 array}."""
+    sd = load_state_dict(ckpt_dir, tag, prefix="params")
+    out = {}
+    for name, arr in sd.items():
+        key = name[len("params/"):] if name.startswith("params/") else name
+        # jnp.issubdtype, not np: ml_dtypes bfloat16 is not np.floating
+        if jnp.issubdtype(arr.dtype, jnp.floating):
+            arr = arr.astype(np.float32)
+        out[key] = arr
+    return out
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(ckpt_dir: str, output_file: str,
+                                               tag: Optional[str] = None
+                                               ) -> str:
+    """Reference ``convert_zero_checkpoint_to_fp32_state_dict``: write a
+    consolidated fp32 state dict file."""
+    sd = get_fp32_state_dict_from_zero_checkpoint(ckpt_dir, tag)
+    os.makedirs(os.path.dirname(os.path.abspath(output_file)), exist_ok=True)
+    try:
+        import torch
+
+        torch.save({k: torch.from_numpy(np.ascontiguousarray(v))
+                    for k, v in sd.items()}, output_file)
+    except ImportError:  # pragma: no cover - torch is baked into the image
+        np.savez(output_file, **sd)
+    return output_file
+
+
+def main():  # pragma: no cover - thin CLI
+    p = argparse.ArgumentParser(
+        description="Consolidate a dstpu checkpoint into an fp32 state dict")
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_file")
+    p.add_argument("-t", "--tag", default=None)
+    a = p.parse_args()
+    path = convert_zero_checkpoint_to_fp32_state_dict(
+        a.checkpoint_dir, a.output_file, a.tag)
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -62,7 +62,8 @@ class InferenceEngineV2:
         want_blocks = 0
         for u, n in zip(uids, lengths):
             d = self.seqs.get(u)
-            cached = d.n_cached if d else 0
+            # undrained pending tokens count toward context/KV demand too
+            cached = (d.n_cached + len(d.pending)) if d else 0
             have = len(d.blocks) if d else 0
             if cached + n > cfg.max_context:
                 return False

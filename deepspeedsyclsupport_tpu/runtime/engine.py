@@ -507,6 +507,36 @@ class Engine:
         log_dist(f"loaded checkpoint {path}")
         return path, meta.get("client_state", {})
 
+    def save_16bit_model(self, save_dir: str,
+                         checkpoint_name: str = "mp_rank_00_model_states.pt"
+                         ) -> str:
+        """Gather full (unsharded) weights and write one bf16 state-dict file
+        (reference ``zero_gather_16bit_weights_on_model_save`` → engine
+        ``save_16bit_model``, ``engine.py:771``). The gather the reference does
+        with ZeRO-3 collectives is a host ``device_get`` of the logical array
+        here — XLA assembles shards transparently."""
+        import torch
+
+        os.makedirs(save_dir, exist_ok=True)
+        out = os.path.join(save_dir, checkpoint_name)
+        from ..checkpoint.engine import _leaf_paths
+
+        names = _leaf_paths(self.params)
+        leaves = jax.tree_util.tree_leaves(self.params)
+        sd = {}
+        for name, leaf in zip(names, leaves):
+            arr = np.asarray(jax.device_get(leaf))
+            # jnp.issubdtype: ml_dtypes bfloat16 is not np.floating
+            if jnp.issubdtype(arr.dtype, jnp.floating):
+                # torch has no bfloat16 numpy bridge: go through fp32 view
+                sd[name] = torch.from_numpy(
+                    np.ascontiguousarray(arr.astype(np.float32))).bfloat16()
+            else:
+                sd[name] = torch.from_numpy(np.ascontiguousarray(arr))
+        torch.save(sd, out)
+        log_dist(f"saved 16-bit model to {out}")
+        return out
+
     def _validate_tag(self, tag: str):
         """Tag agreement across processes (reference ``_checkpoint_tag_validation:
         3033`` — bf16 allreduce of the tag hash)."""
