@@ -1,0 +1,114 @@
+"""Autotuner — measured search over engine configs.
+
+Analog of ``deepspeed/autotuning/`` (2717 LoC): the reference forks whole
+training jobs per experiment, scrapes metric files, and model-prunes the space
+(``autotuner.py`` ``tune_space`` / ``model_based_tuning``). Under JAX an
+"experiment" is cheap — build an Engine in-process, jit once, time a few steps —
+so the same search collapses to a loop:
+
+* space: micro-batch size × ZeRO stage (× user extras), fastest-first ordering.
+* metric: measured samples/sec (or tokens/sec) over ``steps`` after warmup —
+  the same `throughput` metric the reference optimizes.
+* OOM-safe: a failing candidate (XLA OOM / bad config) scores -inf and the
+  search continues, mirroring the reference's failed-experiment handling.
+"""
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..utils.logging import log_dist, logger
+
+
+@dataclass
+class TuneResult:
+    best_config: Dict[str, Any]
+    best_throughput: float  # samples/sec
+    trials: List[Dict[str, Any]] = field(default_factory=list)
+
+
+DEFAULT_SPACE = {
+    "train_micro_batch_size_per_gpu": [1, 2, 4, 8, 16],
+    "zero_optimization.stage": [0, 1, 2, 3],
+}
+
+
+def _set_nested(cfg: Dict, dotted: str, value):
+    parts = dotted.split(".")
+    d = cfg
+    for p in parts[:-1]:
+        d = d.setdefault(p, {})
+    d[parts[-1]] = value
+
+
+class Autotuner:
+    def __init__(self, model, base_config: Dict[str, Any],
+                 make_batch: Callable[[int], Any],
+                 space: Optional[Dict[str, Sequence]] = None,
+                 steps: int = 3, warmup: int = 1):
+        """``make_batch(global_batch_size) -> batch`` supplies data per trial."""
+        self.model = model
+        self.base_config = base_config
+        self.make_batch = make_batch
+        self.space = space or DEFAULT_SPACE
+        self.steps = steps
+        self.warmup = warmup
+
+    def tune(self) -> TuneResult:
+        keys = list(self.space)
+        trials = []
+        best = (None, float("-inf"))
+        for combo in itertools.product(*(self.space[k] for k in keys)):
+            cfg = _deepcopy_config(self.base_config)
+            for k, v in zip(keys, combo):
+                _set_nested(cfg, k, v)
+            label = dict(zip(keys, combo))
+            tput = self._measure(cfg, label)
+            trials.append({**label, "throughput": tput})
+            if tput > best[1]:
+                best = (cfg, tput)
+        if best[0] is None:
+            raise RuntimeError("no autotuning candidate succeeded")
+        result = TuneResult(best[0], best[1], trials)
+        log_dist(f"autotune: best {best[1]:.1f} samples/s with "
+                 f"{ {k: _get_nested(best[0], k) for k in keys} }")
+        return result
+
+    # ------------------------------------------------------------------ trial
+    def _measure(self, cfg: Dict[str, Any], label) -> float:
+        import jax
+
+        from ..comm.topology import reset_world_topology
+        from ..runtime.engine import initialize
+
+        try:
+            reset_world_topology()
+            engine, *_ = initialize(model=self.model, config=cfg)
+            batch = self.make_batch(engine.train_batch_size())
+            for _ in range(self.warmup):
+                engine.train_batch(batch)
+            jax.block_until_ready(engine.params)
+            t0 = time.perf_counter()
+            for _ in range(self.steps):
+                m = engine.train_batch(batch)
+            jax.block_until_ready(m["loss"])
+            dt = time.perf_counter() - t0
+            tput = self.steps * engine.train_batch_size() / dt
+            log_dist(f"autotune trial {label}: {tput:.1f} samples/s")
+            return tput
+        except Exception as e:  # OOM / invalid combo → skip, keep searching
+            logger.warning("autotune trial %s failed: %s", label, e)
+            return float("-inf")
+
+
+def _deepcopy_config(cfg):
+    import copy
+
+    return copy.deepcopy(cfg)
+
+
+def _get_nested(cfg: Dict, dotted: str):
+    d = cfg
+    for p in dotted.split("."):
+        d = d[p]
+    return d

@@ -1,0 +1,3 @@
+from .quantize import (QuantConfig, dequantize_int8, fake_quant,  # noqa: F401
+                       quantize_int8)
+from .compress import compress, get_compression_config  # noqa: F401

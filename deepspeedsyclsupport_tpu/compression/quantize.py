@@ -1,0 +1,78 @@
+"""Quantization primitives: QAT fake-quant + int8 pack/unpack.
+
+Analogs of the reference's quantization stack:
+
+* training fake-quant (``deepspeed/compression/basic_layer.py`` QuantAct/
+  Embedding/LinearLayer_Compress; kernels ``csrc/quantization/fake_quantizer.cu``)
+  → :func:`fake_quant` with a straight-through estimator, pure jnp (XLA fuses
+  the round-trip into the surrounding ops — the fusion the CUDA kernel exists
+  to provide).
+* int8 symmetric blockwise (de)quantize (``csrc/quantization/quantize.cu`` /
+  ``dequantize.cu``) → :func:`quantize_int8` / :func:`dequantize_int8`, the
+  building block the quantized collectives (ZeRO++ qwZ/qgZ analogs,
+  ``comm/quantized.py``) ride on.
+"""
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class QuantConfig:
+    bits: int = 8
+    symmetric: bool = True
+    group_size: int = -1  # -1: per-tensor; else blockwise along last dim
+
+
+def fake_quant(x: jnp.ndarray, bits: int = 8, symmetric: bool = True
+               ) -> jnp.ndarray:
+    """Quantize-dequantize with straight-through gradients (QAT)."""
+    q, scale, zero = _affine_params(x, bits, symmetric)
+    y = (q - zero) * scale
+    # STE: forward quantized value, backward identity
+    return x + jax.lax.stop_gradient(y - x)
+
+
+def _affine_params(x, bits: int, symmetric: bool):
+    levels = 2 ** bits
+    if symmetric:
+        amax = jnp.max(jnp.abs(x)) + 1e-12
+        scale = amax / (levels / 2 - 1)
+        q = jnp.clip(jnp.round(x / scale), -(levels // 2 - 1), levels // 2 - 1)
+        return q, scale, 0.0
+    lo, hi = jnp.min(x), jnp.max(x)
+    scale = (hi - lo + 1e-12) / (levels - 1)
+    zero = jnp.round(-lo / scale)
+    q = jnp.clip(jnp.round(x / scale) + zero, 0, levels - 1)
+    return q, scale, zero
+
+
+def quantize_int8(x: jnp.ndarray, group_size: int = -1
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8: returns (q int8, scales fp32). Blockwise over the last
+    dim when ``group_size > 0`` (the layout comm quantization needs: one scale
+    per ICI transfer chunk, reference ``swizzled_quantize.cu``)."""
+    if group_size and group_size > 0:
+        shape = x.shape
+        assert shape[-1] % group_size == 0, (shape, group_size)
+        xg = x.reshape(*shape[:-1], shape[-1] // group_size, group_size)
+        amax = jnp.max(jnp.abs(xg), axis=-1, keepdims=True) + 1e-12
+        scale = (amax / 127.0).astype(jnp.float32)
+        q = jnp.clip(jnp.round(xg / scale), -127, 127).astype(jnp.int8)
+        return q.reshape(shape), scale.squeeze(-1)
+    amax = jnp.max(jnp.abs(x)) + 1e-12
+    scale = (amax / 127.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_int8(q: jnp.ndarray, scale: jnp.ndarray, group_size: int = -1,
+                    dtype=jnp.float32) -> jnp.ndarray:
+    if group_size and group_size > 0:
+        shape = q.shape
+        qg = q.reshape(*shape[:-1], shape[-1] // group_size, group_size)
+        out = qg.astype(jnp.float32) * scale[..., None]
+        return out.reshape(shape).astype(dtype)
+    return (q.astype(jnp.float32) * scale).astype(dtype)

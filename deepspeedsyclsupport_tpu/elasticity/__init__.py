@@ -1,0 +1,2 @@
+from .elasticity import (ElasticityConfigError, ElasticityError,  # noqa: F401
+                         compute_elastic_config, get_compatible_gpus)

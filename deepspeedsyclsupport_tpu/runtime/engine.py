@@ -181,6 +181,9 @@ class Engine:
             batch_size=self.config.train_batch_size,
             steps_per_output=self.config.steps_per_print)
         self.monitor = MonitorMaster(self.config.monitor)
+        from ..profiling.flops_profiler import FlopsProfiler
+
+        self.flops_profiler = FlopsProfiler(self)
         self.losses = None
 
     # ================================================================ loss core
@@ -269,6 +272,7 @@ class Engine:
             }
             return new_params, new_opt, new_scaler, out_metrics
 
+        self._train_batch_raw = train_batch_fn  # unjitted, for the profiler
         return jax.jit(train_batch_fn, donate_argnums=(0, 1, 2))
 
     def train_batch(self, batch) -> Dict[str, Any]:
@@ -290,6 +294,12 @@ class Engine:
                                  batch, rng)
         self.global_steps += 1
         self.micro_steps += gas
+        if self.config.flops_profiler.enabled:
+            # post-donation the old state is gone; new state has identical
+            # shapes, which is all static FLOP analysis needs
+            self.flops_profiler.maybe_profile(
+                self._train_batch_raw,
+                (self.params, self.opt_state, self.scaler_state, batch, rng))
         self._post_step(metrics)
         return metrics
 

@@ -1,0 +1,181 @@
+"""Aux-ring tests: flops profiler, elasticity, compression, autotuner
+(reference analogs: ``tests/unit/{profiling,elasticity,compression,autotuning}``).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeedsyclsupport_tpu as dstpu
+from deepspeedsyclsupport_tpu.autotuning import Autotuner
+from deepspeedsyclsupport_tpu.compression import (compress, dequantize_int8,
+                                                  fake_quant, quantize_int8)
+from deepspeedsyclsupport_tpu.elasticity import (ElasticityConfigError,
+                                                 ElasticityError,
+                                                 compute_elastic_config,
+                                                 get_compatible_gpus)
+from deepspeedsyclsupport_tpu.models import build_model
+from deepspeedsyclsupport_tpu.profiling import get_model_profile, profile_fn
+from tests.unit.simple_model import SimpleModel, simple_config
+
+
+# ------------------------------------------------------------------- profiler
+class TestFlopsProfiler:
+    def test_matmul_exact(self):
+        a = jnp.zeros((8, 32))
+        b = jnp.zeros((32, 16))
+        p = profile_fn(lambda x, y: x @ y, a, b)
+        assert p.total_flops == 2 * 8 * 32 * 16
+        assert "dot_general" in p.by_primitive
+
+    def test_scan_multiplies(self):
+        w = jnp.zeros((4, 16, 16))  # 4 layers
+
+        def fn(w, x):
+            return jax.lax.scan(lambda h, wl: (h @ wl, None), x, w)[0]
+
+        p = profile_fn(fn, w, jnp.zeros((2, 16)))
+        assert p.by_primitive["dot_general"] == 4 * 2 * 2 * 16 * 16
+
+    def test_model_profile_scales_with_seq(self):
+        model = build_model("tiny")
+        p1 = get_model_profile(model, batch_size=1, seq_len=32)
+        p2 = get_model_profile(model, batch_size=1, seq_len=64)
+        assert p2.total_flops > 1.9 * p1.total_flops
+        assert p1.total_params == sum(
+            int(np.prod(np.shape(l)))
+            for l in jax.tree_util.tree_leaves(model.init_params()))
+
+    def test_reduction_costed_by_input(self):
+        p = profile_fn(lambda x: jnp.sum(x), jnp.zeros((64, 64)))
+        assert p.by_primitive["reduce_sum"] == 64 * 64
+
+    def test_engine_hook_writes_profile(self, tmp_path):
+        out = tmp_path / "flops.txt"
+        engine, *_ = dstpu.initialize(
+            model=SimpleModel(),
+            config=simple_config(flops_profiler={
+                "enabled": True, "profile_step": 1,
+                "output_file": str(out)}))
+        batch = {"x": np.zeros((2, 32), np.float32),
+                 "y": np.zeros((2, 32), np.float32)}
+        engine.train_batch(batch)
+        assert out.exists() and "flops" in out.read_text()
+        assert engine.flops_profiler.profile.total_flops > 0
+
+
+# ------------------------------------------------------------------ elasticity
+class TestElasticity:
+    def test_compatible_gpus(self):
+        batch, gpus = get_compatible_gpus(
+            max_acceptable_batch_size=10000,
+            micro_batches=[8, 12, 16, 17], min_gpus=32, max_gpus=1500)
+        # every valid gpu count must evenly produce the batch from some micro
+        for g in gpus:
+            assert any(batch % (mb * g) == 0 for mb in [8, 12, 16, 17])
+        assert batch <= 10000 and gpus
+
+    def test_full_config_resolution(self):
+        cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 2048,
+                              "micro_batch_sizes": [2, 4, 8],
+                              "min_gpus": 1, "max_gpus": 512}}
+        r = compute_elastic_config(cfg, target_deployment_size=64)
+        assert r.final_batch_size % (r.micro_batch_per_gpu * 64) == 0
+        assert r.final_batch_size == (r.micro_batch_per_gpu *
+                                      r.gradient_accumulation_steps * 64)
+
+    def test_disabled_raises(self):
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config({"elasticity": {"enabled": False}})
+
+    def test_mp_indivisible_deployment_raises(self):
+        cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 64,
+                              "micro_batch_sizes": [2],
+                              "model_parallel_size": 2}}
+        with pytest.raises(ElasticityError):
+            compute_elastic_config(cfg, target_deployment_size=65)
+
+    def test_incompatible_deployment_raises(self):
+        cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 8,
+                              "micro_batch_sizes": [4], "max_gpus": 2}}
+        with pytest.raises(ElasticityError):
+            compute_elastic_config(cfg, target_deployment_size=3)
+
+
+# ----------------------------------------------------------------- compression
+class TestQuantization:
+    def test_int8_roundtrip_error_bounded(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (64, 128))
+        q, s = quantize_int8(x)
+        y = dequantize_int8(q, s)
+        assert q.dtype == jnp.int8
+        assert float(jnp.abs(x - y).max()) <= float(s) * 0.5 + 1e-6
+
+    def test_blockwise_tighter_than_per_tensor(self):
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 256)) * \
+            jnp.linspace(0.01, 10.0, 4)[:, None]  # wildly varying rows
+        qt, st = quantize_int8(x)
+        qb, sb = quantize_int8(x, group_size=64)
+        err_t = float(jnp.abs(x - dequantize_int8(qt, st)).mean())
+        err_b = float(jnp.abs(x - dequantize_int8(qb, sb, group_size=64)).mean())
+        assert err_b < err_t
+
+    def test_fake_quant_ste_gradient(self):
+        x = jnp.linspace(-1, 1, 32)
+        g = jax.grad(lambda v: jnp.sum(fake_quant(v) * 2.0))(x)
+        np.testing.assert_allclose(np.asarray(g), 2.0)  # straight-through
+
+    def test_compress_config_driven(self):
+        params = {"attn": {"wq": jax.random.normal(jax.random.PRNGKey(2),
+                                                   (32, 32))},
+                  "norm": {"scale": jnp.ones((32,))}}
+        cfg = {"compression_training": {"sparse_pruning": {
+            "shared_parameters": {"enabled": True},
+            "different_groups": {"sp1": {"params": {"dense_ratio": 0.25},
+                                         "modules": ["attn"]}}}}}
+        out = compress(params, cfg)
+        w = np.asarray(out["attn"]["wq"])
+        density = (w != 0).mean()
+        assert 0.2 <= density <= 0.3
+        np.testing.assert_array_equal(np.asarray(out["norm"]["scale"]),
+                                      np.ones((32,)))  # 1-D untouched
+
+    def test_per_group_settings_respected(self):
+        """Different groups keep their own settings (regression: first group's
+        params were once applied to every matched module)."""
+        rng = jax.random.PRNGKey(3)
+        params = {"attn": {"w": jax.random.normal(rng, (64, 64))},
+                  "mlp": {"w": jax.random.normal(rng, (64, 64))}}
+        cfg = {"compression_training": {"sparse_pruning": {
+            "shared_parameters": {"enabled": True},
+            "different_groups": {
+                "sp1": {"params": {"dense_ratio": 0.75}, "modules": ["attn*"]},
+                "sp2": {"params": {"dense_ratio": 0.25}, "modules": ["mlp*"]},
+            }}}}
+        out = compress(params, cfg)
+        d_attn = (np.asarray(out["attn"]["w"]) != 0).mean()
+        d_mlp = (np.asarray(out["mlp"]["w"]) != 0).mean()
+        assert 0.7 <= d_attn <= 0.8
+        assert 0.2 <= d_mlp <= 0.3
+
+
+# ------------------------------------------------------------------ autotuner
+class TestAutotuner:
+    def test_picks_best_and_survives_failures(self):
+        model = SimpleModel()
+
+        def make_batch(bs):
+            return {"x": np.zeros((bs, 32), np.float32),
+                    "y": np.zeros((bs, 32), np.float32)}
+
+        tuner = Autotuner(
+            model, simple_config(),
+            make_batch,
+            space={"train_micro_batch_size_per_gpu": [2, -1]},  # -1 → invalid
+            steps=2, warmup=1)
+        res = tuner.tune()
+        assert res.best_throughput > 0
+        assert res.best_config["train_micro_batch_size_per_gpu"] == 2
+        bad = [t for t in res.trials
+               if t["train_micro_batch_size_per_gpu"] == -1]
+        assert bad and bad[0]["throughput"] == float("-inf")
