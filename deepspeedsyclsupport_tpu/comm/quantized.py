@@ -1,0 +1,121 @@
+"""Quantized collectives — ZeRO++ communication analogs.
+
+Reference (SURVEY.md §2.4 ZeRO++ row):
+
+* **qwZ** — quantized-weight all-gather: ZeRO-3's param gather ships int8
+  blocks instead of fp16 (``CUDAQuantizer``, ``partition_parameters.py:679``;
+  kernel ``csrc/quantization/swizzled_quantize.cu`` arranges scales per
+  communication chunk). Here: :func:`quantized_all_gather`.
+* **qgZ** — quantized-gradient reduce: fused all-to-all + dequant-reduce
+  (``all_to_all_quant_reduce``, ``runtime/comm/coalesced_collectives.py``;
+  kernel ``csrc/quantization/quant_reduce.cu``). Here:
+  :func:`all_to_all_quant_reduce`.
+
+Both are shard_map-level ops: XLA's automatic SPMD collectives can't be
+intercepted, so quantized transport is an EXPLICIT choice at the call site
+(e.g. a manual FSDP gather or the gradient sync of a shard_map DP loop). The
+int8 payload + fp32 per-block scales travel as separate arrays — the same wire
+split the swizzled CUDA layout achieves, with XLA free to overlap both
+transfers on ICI.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..compression.quantize import dequantize_int8, quantize_int8
+
+
+def _block_quant(x: jnp.ndarray, group_size: int
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
+    """Flatten + pad to a multiple of group_size, blockwise int8 quantize."""
+    flat = x.reshape(-1)
+    pad = (-flat.size) % group_size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    q, s = quantize_int8(flat, group_size=group_size)
+    return q, s, pad
+
+
+def quantized_all_gather(x: jnp.ndarray, axis_name: str,
+                         group_size: int = 256,
+                         dtype=None) -> jnp.ndarray:
+    """All-gather with int8 transport (qwZ). Use inside shard_map.
+
+    Local shard [n, ...] → [W·n, ...] along dim 0, where W = axis size.
+    ~4× less ICI traffic than fp32 gather (int8 payload + 1 fp32 scale per
+    ``group_size`` elements).
+    """
+    dtype = dtype or x.dtype
+    q, s, pad = _block_quant(x, group_size)
+    qg = lax.all_gather(q, axis_name)            # [W, padded] int8 on the wire
+    sg = lax.all_gather(s, axis_name)            # [W, padded/group] fp32
+    deq = dequantize_int8(qg, sg, group_size=group_size, dtype=dtype)
+    if pad:
+        deq = deq[:, :-pad]
+    w = deq.shape[0]
+    return deq.reshape((w * x.shape[0],) + x.shape[1:])
+
+
+def all_to_all_quant_reduce(x: jnp.ndarray, axis_name: str,
+                            group_size: int = 256) -> jnp.ndarray:
+    """Quantized reduce-scatter mean via all-to-all (qgZ). Use inside shard_map.
+
+    Local [W·n, ...] (W gradient chunks, one per rank) → this rank's mean chunk
+    [n, ...]. Single-hop all-to-all of int8 chunks, then dequant + mean — the
+    one-shot hierarchy-free form of the reference's fused quant_reduce.
+    """
+    w = lax.psum(1, axis_name)
+    assert x.shape[0] % w == 0, (x.shape, w)
+    n = x.shape[0] // w
+    chunks = x.reshape((w, n) + x.shape[1:])
+    flat = chunks.reshape(w, -1)
+    pad = (-flat.shape[1]) % group_size
+    if pad:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((w, pad), flat.dtype)], axis=1)
+    q, s = quantize_int8(flat, group_size=group_size)
+    # one chunk to each peer; receive one chunk from each peer
+    qt = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    st = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    deq = dequantize_int8(qt, st, group_size=group_size, dtype=jnp.float32)
+    if pad:
+        deq = deq[:, :-pad]
+    mean = deq.mean(axis=0)
+    return mean.reshape((n,) + x.shape[1:]).astype(x.dtype)
+
+
+def sign_compress(corrected: jnp.ndarray
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """1-bit compression operator: (sign int8, fp32 scale, residual).
+
+    ``corrected`` is the error-feedback-corrected tensor; the residual feeds
+    the next step. Zero maps to +1 so dequantization is exactly
+    ``scale · sign`` (one convention everywhere — local and wire paths must
+    agree or error feedback breaks)."""
+    scale = jnp.mean(jnp.abs(corrected))
+    sign = jnp.where(corrected >= 0, jnp.int8(1), jnp.int8(-1))
+    residual = corrected - scale * sign.astype(corrected.dtype)
+    return sign, scale, residual
+
+
+def compressed_allreduce(x: jnp.ndarray, error: jnp.ndarray, axis_name: str
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """1-bit error-feedback allreduce (reference
+    ``NcclBackend.compressed_allreduce``, ``runtime/comm/nccl.py:51``; the
+    engine of 1-bit Adam/LAMB). Use inside shard_map.
+
+    Sends sign bits (int8 on the wire) + one fp32 scale per rank; the local
+    compression residue feeds back into the next call, so the *sequence* of
+    allreduces is unbiased even though each one is 1-bit.
+
+    Returns (averaged tensor, new error feedback).
+    """
+    corrected = x + error
+    sign, scale, new_error = sign_compress(corrected)
+    signs_g = lax.all_gather(sign, axis_name)        # [W, ...] int8 wire
+    scales_g = lax.all_gather(scale, axis_name)      # [W] fp32
+    avg = jnp.tensordot(scales_g, signs_g.astype(jnp.float32), axes=1) \
+        / signs_g.shape[0]
+    return avg.astype(x.dtype), new_error

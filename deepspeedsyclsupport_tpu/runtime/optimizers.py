@@ -45,21 +45,34 @@ def build_optimizer(opt_type: str, params: Dict[str, Any],
                     lr_schedule: Optional[Callable] = None) -> optax.GradientTransformation:
     """Map config ``optimizer.type``+``params`` to an optax transform.
 
-    1-bit variants (error-feedback compressed allreduce, reference
-    ``runtime/fp16/onebit/``) have no benefit when gradients are reduce-scattered
-    over ICI by XLA; they resolve to their dense counterparts with a notice (the
-    compression analog for cross-DCN traffic lives in ``parallel/quantized.py``).
+    1-bit Adam (reference ``runtime/fp16/onebit/adam.py``) maps to the native
+    transform in ``runtime/onebit.py`` (frozen-variance + error-feedback
+    sign-compressed momentum); the wire-compressed collective itself lives in
+    ``comm/quantized.py`` for shard_map DP loops — under plain GSPMD the
+    gradient mean is fused into the backward pass, so compression applies to
+    the momentum operator instead.
     """
     t = opt_type.lower().replace("_", "")
     lr, betas, eps, wd = _common(params)
     schedule = lr_schedule if lr_schedule is not None else lr
 
     if t in (ONEBIT_ADAM, ZERO_ONE_ADAM):
-        logger.warning("%s resolves to adam on TPU (ICI makes 1-bit compression moot)",
-                       opt_type)
-        t = ADAM_OPTIMIZER
+        from .onebit import onebit_adam
+
+        if t == ZERO_ONE_ADAM:
+            logger.warning(
+                "ZeroOneAdam approximated by 1-bit Adam (fixed freeze_step "
+                "instead of 0/1's adaptive variance-freeze/sync policies)")
+        # static_args: only the LR is a traced hyperparam — the rest gate
+        # python control flow in the factory and must stay concrete under jit
+        return optax.inject_hyperparams(
+            onebit_adam,
+            static_args=("b1", "b2", "eps", "freeze_step", "weight_decay"))(
+            learning_rate=schedule, b1=betas[0], b2=betas[1], eps=eps,
+            freeze_step=int(params.get("freeze_step", 100)), weight_decay=wd)
     if t == ONEBIT_LAMB:
-        logger.warning("%s resolves to lamb on TPU", opt_type)
+        logger.warning("%s resolves to lamb on TPU (compressed-momentum LAMB "
+                       "pending)", opt_type)
         t = LAMB_OPTIMIZER
 
     if t in (ADAMW_OPTIMIZER, FUSED_ADAM, CPU_ADAM):
