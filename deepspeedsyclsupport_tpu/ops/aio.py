@@ -1,0 +1,86 @@
+"""Python surface of the async-IO op.
+
+Analog of the reference's ``deepspeed.ops.aio`` / ``AsyncIOBuilder().load()``
+handle object (``csrc/aio/py_lib/deepspeed_py_aio_handle.cpp``): submit
+pread/pwrite against numpy buffers, overlap with compute, wait/poll for
+completion. Feeds ``runtime/swap_tensor.py`` (NVMe offload).
+"""
+import ctypes
+from typing import Dict
+
+import numpy as np
+
+from .op_builder import AsyncIOBuilder
+
+
+class AsyncIOHandle:
+    """Thread-pooled async file IO (reference ``aio_handle``)."""
+
+    def __init__(self, n_threads: int = 4):
+        lib = AsyncIOBuilder().load()
+        lib.dstpu_aio_new.restype = ctypes.c_void_p
+        lib.dstpu_aio_new.argtypes = [ctypes.c_int]
+        lib.dstpu_aio_free.argtypes = [ctypes.c_void_p]
+        lib.dstpu_aio_pread.restype = ctypes.c_int64
+        lib.dstpu_aio_pread.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_void_p, ctypes.c_int64,
+                                        ctypes.c_int64]
+        lib.dstpu_aio_pwrite.restype = ctypes.c_int64
+        lib.dstpu_aio_pwrite.argtypes = lib.dstpu_aio_pread.argtypes
+        lib.dstpu_aio_wait.restype = ctypes.c_int
+        lib.dstpu_aio_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.dstpu_aio_poll.restype = ctypes.c_int
+        lib.dstpu_aio_poll.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        self._lib = lib
+        self._h = lib.dstpu_aio_new(int(n_threads))
+        # keep submitted buffers alive until reaped (the pinned-tensor-manager
+        # concern of the reference, reduced to a refcount)
+        self._inflight: Dict[int, np.ndarray] = {}
+
+    def _check_open(self):
+        if self._h is None:
+            raise RuntimeError("AsyncIOHandle used after close()")
+
+    def pwrite(self, path: str, arr: np.ndarray, offset: int = 0) -> int:
+        self._check_open()
+        arr = np.ascontiguousarray(arr)
+        req = self._lib.dstpu_aio_pwrite(
+            self._h, path.encode(), arr.ctypes.data_as(ctypes.c_void_p),
+            arr.nbytes, offset)
+        self._inflight[req] = arr
+        return req
+
+    def pread(self, path: str, arr: np.ndarray, offset: int = 0) -> int:
+        self._check_open()
+        assert arr.flags["C_CONTIGUOUS"] and arr.flags["WRITEABLE"]
+        req = self._lib.dstpu_aio_pread(
+            self._h, path.encode(), arr.ctypes.data_as(ctypes.c_void_p),
+            arr.nbytes, offset)
+        self._inflight[req] = arr
+        return req
+
+    def wait(self, req: int) -> None:
+        self._check_open()
+        rc = self._lib.dstpu_aio_wait(self._h, req)
+        self._inflight.pop(req, None)
+        if rc != 1:
+            raise OSError(-rc, f"async io request {req} failed")
+
+    def poll(self, req: int) -> bool:
+        """True when complete (does not reap; call wait() to finalize)."""
+        self._check_open()
+        rc = self._lib.dstpu_aio_poll(self._h, req)
+        if rc < 0:
+            raise OSError(-rc, f"async io request {req} failed")
+        return rc == 1
+
+    def close(self):
+        if self._h:
+            self._lib.dstpu_aio_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,105 @@
+"""JIT build system for native (C++) ops.
+
+Analog of the reference's op-builder layer (``op_builder/builder.py:108``
+``OpBuilder`` ABC with ``sources()/include_paths()/load()/jit_load()``; CUDA
+arch handling at ``:543``; SYCL variant ``op_builder/xpu/builder.py:19``). The
+reference compiles pybind11 extensions through ``torch.utils.cpp_extension``;
+here native code is host-side systems code (async IO, future RPC) exposed over
+a C ABI and loaded with ``ctypes`` — no Python C API, no torch dependency, and
+the .so is cached by source hash so rebuilds only happen when sources change
+(the role of the reference's build-cache + version checks).
+
+Math ops never come through here: XLA/Pallas owns device compute
+(SURVEY.md §7 native-code policy).
+"""
+import ctypes
+import hashlib
+import os
+import subprocess
+from typing import List, Optional
+
+from ..utils.logging import logger
+
+_REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", ".."))
+_CACHE_DIR = os.environ.get(
+    "DSTPU_OPS_CACHE",
+    os.path.join(os.path.expanduser("~"), ".cache", "dstpu_ops"))
+
+
+class OpBuilderError(RuntimeError):
+    pass
+
+
+class OpBuilder:
+    NAME = "base"
+
+    def sources(self) -> List[str]:
+        raise NotImplementedError
+
+    def extra_flags(self) -> List[str]:
+        return []
+
+    def compiler(self) -> str:
+        return os.environ.get("CXX", "g++")
+
+    def is_compatible(self) -> bool:
+        """Reference ``is_compatible()``: can this op build here?"""
+        from shutil import which
+
+        return which(self.compiler()) is not None
+
+    # ------------------------------------------------------------------ build
+    def _source_hash(self) -> str:
+        h = hashlib.sha256()
+        for s in self.sources():
+            with open(s, "rb") as f:
+                h.update(f.read())
+        h.update(" ".join(self.extra_flags()).encode())
+        return h.hexdigest()[:16]
+
+    def so_path(self) -> str:
+        return os.path.join(_CACHE_DIR,
+                            f"{self.NAME}_{self._source_hash()}.so")
+
+    def jit_load(self) -> str:
+        """Compile if the hashed .so is absent (reference ``jit_load:480``)."""
+        out = self.so_path()
+        if os.path.exists(out):
+            return out
+        if not self.is_compatible():
+            raise OpBuilderError(
+                f"op {self.NAME!r}: compiler {self.compiler()!r} not found")
+        os.makedirs(_CACHE_DIR, exist_ok=True)
+        tmp = f"{out}.{os.getpid()}.tmp"  # per-process: concurrent builders
+        # each write their own file; os.replace publishes whichever finishes
+        cmd = [self.compiler(), "-O2", "-fPIC", "-shared", "-std=c++17",
+               "-pthread", *self.extra_flags(), *self.sources(), "-o", tmp]
+        logger.info("building native op %s: %s", self.NAME, " ".join(cmd))
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise OpBuilderError(
+                f"building {self.NAME} failed:\n{proc.stderr}")
+        os.replace(tmp, out)  # atomic: concurrent builders race benignly
+        return out
+
+    def load(self) -> ctypes.CDLL:
+        """Build (if needed) + dlopen (reference ``load:462``)."""
+        return ctypes.CDLL(self.jit_load())
+
+
+class AsyncIOBuilder(OpBuilder):
+    """Reference ``op_builder/async_io.py`` (libaio probe there; plain POSIX
+    threads here, so it is compatible wherever a C++ compiler exists)."""
+
+    NAME = "aio"
+
+    def sources(self) -> List[str]:
+        return [os.path.join(_REPO_ROOT, "csrc", "aio", "dstpu_aio.cpp")]
+
+
+ALL_OPS = {b.NAME: b for b in (AsyncIOBuilder(),)}
+
+
+def get_op_builder(name: str) -> Optional[OpBuilder]:
+    return ALL_OPS.get(name)
