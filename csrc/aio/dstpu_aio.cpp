@@ -27,6 +27,7 @@ namespace {
 struct Request {
   int64_t id;
   bool write;
+  bool do_fsync;  // durability is opt-in: swap traffic skips it
   std::string path;
   void* buf;
   int64_t nbytes;
@@ -48,11 +49,11 @@ struct Handle {
     for (auto& t : workers) t.join();
   }
 
-  int64_t submit(bool write, const char* path, void* buf, int64_t nbytes,
-                 int64_t offset) {
+  int64_t submit(bool write, bool do_fsync, const char* path, void* buf,
+                 int64_t nbytes, int64_t offset) {
     std::lock_guard<std::mutex> lk(mu);
     int64_t id = next_id++;
-    queue.push_back(Request{id, write, path, buf, nbytes, offset});
+    queue.push_back(Request{id, write, do_fsync, path, buf, nbytes, offset});
     status[id] = 0;  // pending
     cv.notify_one();
     return id;
@@ -100,6 +101,7 @@ struct Handle {
 
   static int execute(const Request& req) {
     int flags = req.write ? (O_WRONLY | O_CREAT) : O_RDONLY;
+    if (req.write && req.offset == 0) flags |= O_TRUNC;  // whole-file write
     int fd = ::open(req.path.c_str(), flags, 0644);
     if (fd < 0) return -errno;
     char* p = static_cast<char*>(req.buf);
@@ -123,7 +125,7 @@ struct Handle {
       remaining -= n;
     }
     int rc = 0;
-    if (req.write && ::fsync(fd) != 0) rc = -errno;
+    if (req.write && req.do_fsync && ::fsync(fd) != 0) rc = -errno;
     if (::close(fd) != 0 && rc == 0) rc = -errno;
     return rc == 0 ? 1 : rc;
   }
@@ -151,13 +153,15 @@ void dstpu_aio_free(void* h) { delete static_cast<Handle*>(h); }
 
 int64_t dstpu_aio_pread(void* h, const char* path, void* buf, int64_t nbytes,
                         int64_t offset) {
-  return static_cast<Handle*>(h)->submit(false, path, buf, nbytes, offset);
+  return static_cast<Handle*>(h)->submit(false, false, path, buf, nbytes,
+                                         offset);
 }
 
 int64_t dstpu_aio_pwrite(void* h, const char* path, const void* buf,
-                         int64_t nbytes, int64_t offset) {
-  return static_cast<Handle*>(h)->submit(true, path, const_cast<void*>(buf),
-                                         nbytes, offset);
+                         int64_t nbytes, int64_t offset, int do_fsync) {
+  return static_cast<Handle*>(h)->submit(true, do_fsync != 0, path,
+                                         const_cast<void*>(buf), nbytes,
+                                         offset);
 }
 
 int dstpu_aio_poll(void* h, int64_t id) {

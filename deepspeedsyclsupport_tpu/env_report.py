@@ -1,0 +1,62 @@
+"""Environment report — the ``ds_report`` CLI analog (reference
+``deepspeed/env_report.py``: torch/cuda/nccl versions + op build status table).
+
+Run: ``python -m deepspeedsyclsupport_tpu.env_report``.
+"""
+import sys
+
+
+def get_report_lines():
+    import jax
+    import jaxlib
+
+    from .accelerator import get_accelerator
+    from .ops.op_builder import ALL_OPS
+    from .version import __version__
+
+    lines = ["-" * 62,
+             "deepspeedsyclsupport_tpu environment report (ds_report analog)",
+             "-" * 62]
+    lines.append(f"dstpu version ........ {__version__}")
+    lines.append(f"jax version .......... {jax.__version__}")
+    lines.append(f"jaxlib version ....... {jaxlib.__version__}")
+    lines.append(f"python ............... {sys.version.split()[0]}")
+    acc = get_accelerator()
+    lines.append(f"accelerator .......... {acc.name()}")
+    try:
+        devs = acc.devices()
+        lines.append(f"devices .............. {len(devs)} × "
+                     f"{getattr(devs[0], 'device_kind', devs[0].platform)}")
+    except Exception as e:  # device probe can fail off-hardware
+        lines.append(f"devices .............. unavailable ({e})")
+    try:
+        import flax
+
+        lines.append(f"flax version ......... {flax.__version__}")
+    except ImportError:
+        pass
+    try:
+        import optax
+
+        lines.append(f"optax version ........ {optax.__version__}")
+    except ImportError:
+        pass
+    lines.append("-" * 62)
+    lines.append("native ops (op_builder):")
+    for name, builder in ALL_OPS.items():
+        import os
+
+        compatible = builder.is_compatible()
+        built = compatible and os.path.exists(builder.so_path())
+        lines.append(f"  {name:<12} compatible: {str(compatible):<5} "
+                     f"built: {built}")
+    lines.append("-" * 62)
+    return lines
+
+
+def main():
+    print("\n".join(get_report_lines()))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

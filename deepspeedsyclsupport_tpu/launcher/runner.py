@@ -1,0 +1,158 @@
+"""Distributed launcher CLI.
+
+Analog of the ``deepspeed`` CLI (``launcher/runner.py:388`` multi-node
+orchestrator → per-node ``launcher/launch.py:132`` process spawner). The
+reference's job: parse a hostfile, compute the world layout, ssh/pdsh to every
+node, spawn one process per accelerator with RANK/LOCAL_RANK/WORLD_SIZE env,
+and reap children on SIGTERM.
+
+TPU shift: JAX is multi-controller — ONE process per host drives all local
+chips, and ``jax.distributed.initialize`` replaces the env:// rendezvous. So
+the launcher spawns one worker per node entry (or per ``--num_procs`` for
+CPU-sim runs), wiring:
+
+* ``DSTPU_COORDINATOR`` / ``JAX_COORDINATOR_ADDRESS`` — coordinator host:port
+* ``DSTPU_PROCESS_ID`` / ``JAX_PROCESS_ID`` + ``JAX_NUM_PROCESSES``
+
+``comm.init_distributed`` reads these (the same contract the reference's
+launcher has with ``deepspeed.init_distributed``). Remote nodes get generated
+ssh command lines (``--dry_run`` prints them; actual fan-out is deferred to
+the cluster scheduler on TPU pods, where GKE/xmanager owns process placement).
+"""
+import argparse
+import os
+import shlex
+import signal
+import subprocess
+import sys
+from typing import Dict, List, Tuple
+
+from ..utils.logging import logger
+
+DEFAULT_MASTER_PORT = 29500
+
+
+def parse_hostfile(path: str) -> List[Tuple[str, int]]:
+    """Reference hostfile format: ``hostname slots=N`` per line
+    (``launcher/runner.py`` ``fetch_hostfile``)."""
+    out: List[Tuple[str, int]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            host = parts[0]
+            slots = 1
+            for p in parts[1:]:
+                if p.startswith("slots="):
+                    slots = int(p.split("=", 1)[1])
+            out.append((host, slots))
+    if not out:
+        raise ValueError(f"hostfile {path} has no host entries")
+    return out
+
+
+def build_world(args) -> List[Dict[str, str]]:
+    """Per-process env blocks (the reference's RANK/WORLD_SIZE assembly in
+    ``launcher/launch.py``, recast for one-controller-per-host)."""
+    if args.hostfile:
+        hosts = parse_hostfile(args.hostfile)
+    else:
+        hosts = [("localhost", 1)] * args.num_nodes
+    if args.include:
+        keep = set(args.include.split(","))
+        hosts = [h for h in hosts if h[0] in keep]
+    if args.exclude:
+        drop = set(args.exclude.split(","))
+        hosts = [h for h in hosts if h[0] not in drop]
+    if not hosts:
+        raise ValueError("no hosts remain after include/exclude filtering")
+
+    coordinator = f"{args.master_addr or hosts[0][0]}:{args.master_port}"
+    world = []
+    n = len(hosts) * max(args.num_procs, 1)
+    pid = 0
+    for host, _slots in hosts:
+        for _ in range(max(args.num_procs, 1)):
+            world.append({
+                "host": host,
+                # names comm.init_distributed reads directly
+                "COORDINATOR_ADDRESS": coordinator,
+                "NUM_PROCESSES": str(n),
+                "PROCESS_ID": str(pid),
+                # reference-compat env:// convention (init_distributed's
+                # fallback, and what user scripts ported from upstream read)
+                "MASTER_ADDR": coordinator.rsplit(":", 1)[0],
+                "MASTER_PORT": coordinator.rsplit(":", 1)[1],
+                "RANK": str(pid),
+                "WORLD_SIZE": str(n),
+                "LOCAL_RANK": "0",
+            })
+            pid += 1
+    return world
+
+
+def _command(args, env: Dict[str, str]) -> List[str]:
+    cmd = [sys.executable]
+    if args.module:
+        cmd.append("-m")
+    cmd.append(args.user_script)
+    cmd += args.user_args
+    if env["host"] not in ("localhost", "127.0.0.1"):
+        exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items()
+                           if k != "host")
+        return ["ssh", env["host"], f"cd {shlex.quote(os.getcwd())} && "
+                f"{exports} {' '.join(shlex.quote(c) for c in cmd)}"]
+    return cmd
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="dstpu", description="deepspeedsyclsupport_tpu launcher "
+        "(reference: the `deepspeed` CLI)")
+    p.add_argument("--hostfile", default=None)
+    p.add_argument("--num_nodes", "-N", type=int, default=1)
+    p.add_argument("--num_procs", type=int, default=1,
+                   help="processes per node (CPU-sim/multi-controller tests)")
+    p.add_argument("--include", default=None, help="comma list of hosts")
+    p.add_argument("--exclude", default=None)
+    p.add_argument("--master_addr", default=None)
+    p.add_argument("--master_port", type=int, default=DEFAULT_MASTER_PORT)
+    p.add_argument("--module", "-m", action="store_true")
+    p.add_argument("--dry_run", action="store_true",
+                   help="print the per-process commands and exit")
+    p.add_argument("user_script")
+    p.add_argument("user_args", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+
+    world = build_world(args)
+    procs: List[subprocess.Popen] = []
+    for env in world:
+        cmd = _command(args, env)
+        if args.dry_run:
+            print(f"[{env['host']}:{env['PROCESS_ID']}] "
+                  + " ".join(shlex.quote(c) for c in cmd))
+            continue
+        full_env = {**os.environ, **{k: v for k, v in env.items()
+                                     if k != "host"}}
+        procs.append(subprocess.Popen(cmd, env=full_env))
+    if args.dry_run:
+        return 0
+
+    def _kill(signum, frame):  # reference launch.py:118 kills the tree
+        logger.warning("launcher: forwarding signal %d", signum)
+        for pr in procs:
+            pr.terminate()
+
+    signal.signal(signal.SIGINT, _kill)
+    signal.signal(signal.SIGTERM, _kill)
+    rc = 0
+    for pr in procs:
+        pr.wait()
+        rc = rc or pr.returncode
+    return rc
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -26,7 +26,8 @@ class AsyncIOHandle:
                                         ctypes.c_void_p, ctypes.c_int64,
                                         ctypes.c_int64]
         lib.dstpu_aio_pwrite.restype = ctypes.c_int64
-        lib.dstpu_aio_pwrite.argtypes = lib.dstpu_aio_pread.argtypes
+        lib.dstpu_aio_pwrite.argtypes = lib.dstpu_aio_pread.argtypes + [
+            ctypes.c_int]
         lib.dstpu_aio_wait.restype = ctypes.c_int
         lib.dstpu_aio_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.dstpu_aio_poll.restype = ctypes.c_int
@@ -41,12 +42,15 @@ class AsyncIOHandle:
         if self._h is None:
             raise RuntimeError("AsyncIOHandle used after close()")
 
-    def pwrite(self, path: str, arr: np.ndarray, offset: int = 0) -> int:
+    def pwrite(self, path: str, arr: np.ndarray, offset: int = 0,
+               fsync: bool = False) -> int:
+        """``fsync=True`` for durability-critical writes (checkpoints); swap
+        scratch traffic keeps the default and skips the device flush."""
         self._check_open()
         arr = np.ascontiguousarray(arr)
         req = self._lib.dstpu_aio_pwrite(
             self._h, path.encode(), arr.ctypes.data_as(ctypes.c_void_p),
-            arr.nbytes, offset)
+            arr.nbytes, offset, 1 if fsync else 0)
         self._inflight[req] = arr
         return req
 
@@ -71,7 +75,8 @@ class AsyncIOHandle:
         self._check_open()
         rc = self._lib.dstpu_aio_poll(self._h, req)
         if rc < 0:
-            raise OSError(-rc, f"async io request {req} failed")
+            self.wait(req)  # reap the failed request, then raise via wait
+            raise OSError(-rc, f"async io request {req} failed")  # fallback
         return rc == 1
 
     def close(self):

@@ -56,6 +56,13 @@ class OpBuilder:
             with open(s, "rb") as f:
                 h.update(f.read())
         h.update(" ".join(self.extra_flags()).encode())
+        # compiler identity: switching CXX (or upgrading it) must rebuild
+        h.update(self.compiler().encode())
+        try:
+            h.update(subprocess.run([self.compiler(), "--version"],
+                                    capture_output=True).stdout)
+        except OSError:
+            pass
         return h.hexdigest()[:16]
 
     def so_path(self) -> str:

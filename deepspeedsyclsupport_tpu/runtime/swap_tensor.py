@@ -47,8 +47,14 @@ class AsyncTensorSwapper:
     def swap_out(self, name: str, tensor) -> None:
         """Start an async write; returns immediately. The host copy stays
         referenced by the aio handle until the write completes."""
+        import hashlib
+
         arr = np.asarray(jax.device_get(tensor))
-        path = os.path.join(self.swap_dir, f"{name.replace('/', '__')}.swp")
+        # readable prefix + name hash: replace() alone is not injective
+        # ('a/b' vs 'a__b' must not alias to one file)
+        digest = hashlib.sha1(name.encode()).hexdigest()[:10]
+        path = os.path.join(
+            self.swap_dir, f"{name.replace('/', '__')}.{digest}.swp")
         e = self._entries.get(name)
         if e is not None:
             # reap ALL in-flight IO on this name: rewriting while an old
@@ -70,8 +76,8 @@ class AsyncTensorSwapper:
         if e.read_req is not None:
             return  # already in flight
         if e.write_req is not None:
-            self.handle.wait(e.write_req)  # read-after-write ordering
-            e.write_req = None
+            req, e.write_req = e.write_req, None  # clear first: wait() reaps
+            self.handle.wait(req)                 # even on failure
         e.read_buf = np.empty(e.shape, e.dtype)
         e.read_req = self.handle.pread(e.path, e.read_buf)
 
@@ -89,8 +95,8 @@ class AsyncTensorSwapper:
         """Drain all in-flight writes (checkpoint barrier)."""
         for e in self._entries.values():
             if e.write_req is not None:
-                self.handle.wait(e.write_req)
-                e.write_req = None
+                req, e.write_req = e.write_req, None  # reaped even on failure
+                self.handle.wait(req)
 
     def release(self, name: str) -> None:
         e = self._entries.pop(name, None)

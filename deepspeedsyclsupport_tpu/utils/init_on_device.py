@@ -1,0 +1,59 @@
+"""Meta-device model init.
+
+Analog of ``OnDevice`` (``deepspeed/utils/init_on_device.py``): construct a
+model "on meta" — shapes/dtypes only, no memory — then materialize onto real
+devices later. The reference patches torch tensor constructors; under JAX this
+is just ``jax.eval_shape`` (abstract tracing is native), and materialization
+is a sharded init: each device initializes ONLY its shard, so a model larger
+than any single host's memory can come up directly distributed — the job
+``zero.Init`` (``partition_parameters.py:734``) does with constructor
+monkey-patching.
+"""
+from typing import Any, Callable, Optional
+
+import jax
+
+from ..comm.topology import MeshTopology
+
+
+def abstract_params(init_fn: Callable, *args, **kwargs) -> Any:
+    """ShapeDtypeStruct tree of ``init_fn(*args)`` without allocating
+    (the ``device='meta'`` construction path)."""
+    return jax.eval_shape(init_fn, *args, **kwargs)
+
+
+def materialize_sharded(init_fn: Callable, shardings: Any, *args,
+                        **kwargs) -> Any:
+    """Run the initializer SPMD: every device computes only its own shard
+    (``zero.Init``'s partition-at-construction, minus the monkey-patching)."""
+    return jax.jit(lambda: init_fn(*args, **kwargs),
+                   out_shardings=shardings)()
+
+
+class OnDevice:
+    """Context-manager parity with the reference API::
+
+        with OnDevice(dtype=jnp.bfloat16, device="meta"):
+            shapes = model.init_params()        # abstract, if model supports it
+
+    JAX needs no global patching, so this context only carries the
+    configuration and offers :meth:`abstract` / :meth:`materialize`.
+    """
+
+    def __init__(self, dtype=None, device: str = "meta",
+                 topology: Optional[MeshTopology] = None):
+        self.dtype = dtype
+        self.device = device
+        self.topology = topology
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+    def abstract(self, init_fn: Callable, *args, **kwargs):
+        return abstract_params(init_fn, *args, **kwargs)
+
+    def materialize(self, init_fn: Callable, shardings, *args, **kwargs):
+        return materialize_sharded(init_fn, shardings, *args, **kwargs)

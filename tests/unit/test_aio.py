@@ -149,6 +149,36 @@ class TestSwapper:
         with pytest.raises(RuntimeError):
             h.pwrite(str(tmp_path / "x.bin"), np.zeros((4,), np.float32))
 
+    def test_name_aliasing_safe(self, tmp_path):
+        """'a/b' and 'a__b' must not share a swap file (regression: replace()
+        alone aliased them)."""
+        from deepspeedsyclsupport_tpu.runtime.swap_tensor import \
+            AsyncTensorSwapper
+
+        sw = AsyncTensorSwapper(str(tmp_path / "swap"))
+        sw.swap_out("a/b", np.zeros((64,), np.float32))
+        sw.swap_out("a__b", np.ones((64,), np.float32))
+        np.testing.assert_array_equal(sw.retrieve("a/b"),
+                                      np.zeros((64,), np.float32))
+        np.testing.assert_array_equal(sw.retrieve("a__b"),
+                                      np.ones((64,), np.float32))
+        sw.close()
+
+    def test_shrinking_rewrite_truncates(self, handle, tmp_path):
+        """offset-0 writes truncate (regression: stale tail bytes)."""
+        path = str(tmp_path / "shrink.bin")
+        handle.wait(handle.pwrite(path, np.zeros((1000,), np.uint8)))
+        handle.wait(handle.pwrite(path, np.ones((100,), np.uint8)))
+        assert os.path.getsize(path) == 100
+
+    def test_poll_failure_reaps(self, handle, tmp_path):
+        out = np.empty((4,), np.float32)
+        req = handle.pread(str(tmp_path / "missing.bin"), out)
+        time.sleep(0.05)  # let the worker fail it
+        with pytest.raises(OSError):
+            handle.poll(req)
+        assert req not in handle._inflight  # reaped, not leaked
+
     def test_unknown_name_raises(self, tmp_path):
         from deepspeedsyclsupport_tpu.runtime.swap_tensor import \
             AsyncTensorSwapper
