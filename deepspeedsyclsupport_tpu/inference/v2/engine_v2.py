@@ -44,6 +44,7 @@ class InferenceEngineV2:
         self.allocator = BlockedAllocator(cfg.num_blocks)
         self.seqs: Dict[int, SequenceDescriptor] = {}
         self._forward = build_ragged_forward_fn(model, cfg.block_size)
+        self._decode_forward = None  # built lazily (kernel path)
         self._rng = jax.random.PRNGKey(cfg.seed)
         self._sample_fn = jax.jit(sample_token, static_argnums=(2,))
         log_dist(f"ragged engine: {cfg.num_blocks} KV blocks × {cfg.block_size} "
@@ -112,12 +113,38 @@ class InferenceEngineV2:
 
     def _run(self, chunks) -> np.ndarray:
         cfg = self.config
+        if all(n == 1 and d.n_cached > 0 for d, n in chunks):
+            return self._run_decode(chunks)  # kernel fast path
         batch = build_ragged_batch(chunks, cfg.max_tokens_per_batch,
                                    cfg.max_sequences, cfg.blocks_per_seq)
         logits, self.kv = self._forward(
             self.params, self.kv, jnp.asarray(batch.tokens),
             jnp.asarray(batch.token_seq), jnp.asarray(batch.token_pos),
             jnp.asarray(batch.block_tables), jnp.asarray(batch.last_tok_idx))
+        return np.asarray(logits[:len(chunks)])
+
+    def _run_decode(self, chunks) -> np.ndarray:
+        """Pure-decode batches (serving's steady state) route through the
+        Pallas paged-attention program (``ops/paged_attention``)."""
+        from .model import build_decode_forward_fn
+
+        cfg = self.config
+        if self._decode_forward is None:
+            self._decode_forward = build_decode_forward_fn(
+                self.model, cfg.block_size)
+        s_max = cfg.max_sequences
+        tokens = np.zeros((s_max,), np.int32)
+        positions = np.zeros((s_max,), np.int32)
+        tables = np.zeros((s_max, cfg.blocks_per_seq), np.int32)
+        active = np.zeros((s_max,), bool)
+        for slot, (d, _n) in enumerate(chunks):
+            tokens[slot] = d.pending[0]
+            positions[slot] = d.n_cached
+            tables[slot, :len(d.blocks)] = d.blocks
+            active[slot] = True
+        logits, self.kv = self._decode_forward(
+            self.params, self.kv, jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(tables), jnp.asarray(active))
         return np.asarray(logits[:len(chunks)])
 
     # ------------------------------------------------------------ query/flush

@@ -135,3 +135,68 @@ def build_ragged_forward_fn(model, block_size: int):
     """Jitted, shape-stable forward (compiled once per engine)."""
     fn = partial(ragged_forward, model, block_size=block_size)
     return jax.jit(fn, donate_argnums=(1,))
+
+
+# ------------------------------------------------------------ decode fast path
+def decode_forward(model, params: Any, kv: BlockedKV, tokens, positions,
+                   block_tables, active, *, block_size: int,
+                   attn_impl: str = "auto") -> Tuple[jnp.ndarray, BlockedKV]:
+    """All-decode forward: ONE token per slot, attention via the Pallas paged
+    decode kernel (``ops/paged_attention`` — the ``blocked_flash`` analog).
+
+    ``tokens``/``positions``/``active``: [S]; positions = tokens already
+    cached (the new token writes slot ``positions[s]``). This is the program
+    serving spends most of its life in, so it gets the kernel; mixed
+    prefill+decode batches take :func:`ragged_forward`.
+    """
+    from ...ops.paged_attention import paged_decode_attention
+
+    cfg = model.config
+    bs = block_size
+    num_slots = kv.num_slots
+    s = tokens.shape[0]
+
+    dest_block = jnp.take_along_axis(
+        block_tables, (positions // bs)[:, None], axis=1)[:, 0]
+    dest = jnp.where(active, dest_block * bs + positions % bs, num_slots)
+    seq_lens = jnp.where(active, positions + 1, 0)
+
+    x = jnp.take(params["embed"]["embedding"], tokens, axis=0)
+    x = x.astype(jnp.dtype(cfg.dtype))
+
+    def layer(x, inp):
+        p, k_cache, v_cache = inp
+        y = rms_norm(x, p["attn_norm"]["scale"], cfg.rms_norm_eps)
+        q = jnp.einsum("sd,dq->sq", y, p["attn"]["wq"]).reshape(
+            s, cfg.num_heads, cfg.head_dim)
+        k = jnp.einsum("sd,dk->sk", y, p["attn"]["wk"]).reshape(
+            s, cfg.num_kv_heads, cfg.head_dim)
+        v = jnp.einsum("sd,dk->sk", y, p["attn"]["wv"]).reshape(
+            s, cfg.num_kv_heads, cfg.head_dim)
+        q = apply_rope(q[None], positions[None], cfg.rope_theta)[0]
+        k = apply_rope(k[None], positions[None], cfg.rope_theta)[0]
+        k_cache = k_cache.at[dest].set(k.astype(k_cache.dtype), mode="drop")
+        v_cache = v_cache.at[dest].set(v.astype(v_cache.dtype), mode="drop")
+        attn = paged_decode_attention(q, k_cache, v_cache, block_tables,
+                                      seq_lens, block_size=bs, impl=attn_impl)
+        x2 = (x + jnp.einsum("sq,qd->sd", attn.reshape(s, cfg.q_dim),
+                             p["attn"]["wo"])).astype(x.dtype)
+        y2 = rms_norm(x2, p["mlp_norm"]["scale"], cfg.rms_norm_eps)
+        h = glu_mlp(p["mlp"], y2[None], cfg)[0]
+        return (x2 + h).astype(x.dtype), (k_cache, v_cache)
+
+    x, (nk, nv) = jax.lax.scan(layer, x, (params["layers"], kv.k, kv.v))
+    x = rms_norm(x, params["final_norm"]["scale"], cfg.rms_norm_eps)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("sd,vd->sv", x,
+                            params["embed"]["embedding"].astype(x.dtype))
+    else:
+        logits = jnp.einsum("sd,dv->sv", x,
+                            params["lm_head"]["kernel"].astype(x.dtype))
+    return logits.astype(jnp.float32), BlockedKV(nk, nv)
+
+
+def build_decode_forward_fn(model, block_size: int, attn_impl: str = "auto"):
+    fn = partial(decode_forward, model, block_size=block_size,
+                 attn_impl=attn_impl)
+    return jax.jit(fn, donate_argnums=(1,))

@@ -1,0 +1,194 @@
+"""Paged (blocked-KV) decode attention — Pallas TPU kernel.
+
+The performance core of the v2 ragged engine: the reference's
+``blocked_flash`` CUDA kernel family (``inference/v2/kernels/ragged_ops/
+blocked_flash``, atom-based flash attention over paged KV). One query token
+per sequence slot attends over its sequence's KV blocks, resolved through a
+block table.
+
+Kernel shape (TPU-first, not a CUDA translation):
+
+* grid = one program per sequence slot; the block table row and sequence
+  length ride in as SCALAR-PREFETCH args so KV block DMAs can be issued
+  immediately (``PrefetchScalarGridSpec`` — the Pallas idiom for indirect
+  addressing).
+* K/V stay in HBM; each loop iteration DMAs ONE KV block into VMEM scratch
+  and folds it into an online-softmax accumulator (flash recurrence), so VMEM
+  holds O(block_size · D) regardless of context length, and compute overlaps
+  the next block's fetch via the DMA queue.
+* GQA: queries reshape to [KVH, G, D] and each kv head batch-matmuls its
+  group — grouped heads share the streamed KV block, the reason GQA decode is
+  bandwidth-cheap on TPU.
+
+An exact jnp reference (:func:`paged_decode_attention_reference`) serves
+off-TPU fallback and the kernel-vs-reference parity tests (the pattern the
+reference repo uses for every CUDA kernel, SURVEY.md §4).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+# --------------------------------------------------------------------- kernel
+def _decode_kernel(block_tables_ref, seq_lens_ref,  # scalar prefetch
+                   q_ref, k_hbm, v_hbm,             # tensors
+                   out_ref,                         # output
+                   k_vmem, v_vmem, sem,             # scratch (double-buffered)
+                   *, block_size: int, max_blocks: int):
+    s = pl.program_id(0)
+    seq_len = seq_lens_ref[s]
+    q = q_ref[0].astype(jnp.float32)          # [H, D]
+    h, d = q.shape
+    kvh = k_vmem.shape[2]
+    g = h // kvh
+    q_g = q.reshape(kvh, g, d)
+
+    def copies(j, slot):
+        blk = block_tables_ref[s, j]
+        cp_k = pltpu.make_async_copy(
+            k_hbm.at[pl.ds(blk * block_size, block_size)], k_vmem.at[slot],
+            sem.at[slot, 0])
+        cp_v = pltpu.make_async_copy(
+            v_hbm.at[pl.ds(blk * block_size, block_size)], v_vmem.at[slot],
+            sem.at[slot, 1])
+        return cp_k, cp_v
+
+    @pl.when(seq_len > 0)  # warm the pipe: block 0 → slot 0
+    def _():
+        cp_k, cp_v = copies(0, 0)
+        cp_k.start()
+        cp_v.start()
+
+    def body(j, carry):
+        m, l, acc = carry
+        active = j * block_size < seq_len
+        cur = jax.lax.rem(j, 2)
+
+        @pl.when((j + 1) * block_size < seq_len)  # start NEXT block's fetch
+        def _():
+            cp_k, cp_v = copies(j + 1, jax.lax.rem(j + 1, 2))
+            cp_k.start()
+            cp_v.start()
+
+        @pl.when(active)  # then wait only for the CURRENT block
+        def _():
+            cp_k, cp_v = copies(j, cur)
+            cp_k.wait()
+            cp_v.wait()
+
+        k = k_vmem[cur].astype(jnp.float32)    # [bs, KVH, D]
+        v = v_vmem[cur].astype(jnp.float32)
+        k_t = jnp.transpose(k, (1, 0, 2))      # [KVH, bs, D]
+        v_t = jnp.transpose(v, (1, 0, 2))
+        # [KVH, G, bs] = batched q_g · k_tᵀ
+        scores = jax.lax.dot_general(
+            q_g, k_t, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) / np.sqrt(d)
+        pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (kvh, g, block_size), 2)
+        valid = jnp.logical_and(pos < seq_len, active)
+        scores = jnp.where(valid, scores, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new)            # [KVH, G, bs]
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(              # [KVH, G, D]
+            p, v_t, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        acc_new = acc * alpha + pv
+        # inactive blocks read an unwritten buffer slot: even with p == 0,
+        # 0 · NaN = NaN, so the carry must be explicitly held
+        return (jnp.where(active, m_new, m), jnp.where(active, l_new, l),
+                jnp.where(active, acc_new, acc))
+
+    m0 = jnp.full((kvh, g, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((kvh, g, 1), jnp.float32)
+    acc0 = jnp.zeros((kvh, g, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, max_blocks, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)
+    out_ref[0] = out.reshape(h, d).astype(out_ref.dtype)
+
+
+def paged_decode_attention_pallas(q, k_cache, v_cache, block_tables, seq_lens,
+                                  *, block_size: int,
+                                  interpret: bool = False):
+    """q: [S, H, D]; k/v_cache: [num_slots, KVH, D]; block_tables: [S, Bps];
+    seq_lens: [S] valid KV tokens per slot. Returns [S, H, D]."""
+    s, h, d = q.shape
+    kvh = k_cache.shape[1]
+    max_blocks = block_tables.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s,),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda i, *_: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),   # K stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),   # V stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda i, *_: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, block_size, kvh, d), k_cache.dtype),  # double buf
+            pltpu.VMEM((2, block_size, kvh, d), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),  # [buffer slot, k|v]
+        ],
+    )
+    kernel = functools.partial(_decode_kernel, block_size=block_size,
+                               max_blocks=max_blocks)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((s, h, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(jnp.asarray(block_tables, jnp.int32), jnp.asarray(seq_lens, jnp.int32),
+      q, k_cache, v_cache)
+
+
+# ------------------------------------------------------------------ reference
+def paged_decode_attention_reference(q, k_cache, v_cache, block_tables,
+                                     seq_lens, *, block_size: int):
+    """Exact jnp implementation (parity target + off-TPU fallback)."""
+    s, h, d = q.shape
+    kvh = k_cache.shape[1]
+    bps = block_tables.shape[1]
+    max_ctx = bps * block_size
+    j = jnp.arange(max_ctx)
+    slot = block_tables[:, j // block_size] * block_size + j % block_size
+    k_seq = k_cache[slot].astype(jnp.float32)   # [S, C, KVH, D]
+    v_seq = v_cache[slot].astype(jnp.float32)
+    if kvh != h:
+        rep = h // kvh
+        k_seq = jnp.repeat(k_seq, rep, axis=2)
+        v_seq = jnp.repeat(v_seq, rep, axis=2)
+    logits = jnp.einsum("shd,schd->shc", q.astype(jnp.float32),
+                        k_seq) / np.sqrt(d)
+    mask = (j[None, :] < seq_lens[:, None])[:, None, :]
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("shc,schd->shd", probs, v_seq)
+    return out.astype(q.dtype)
+
+
+def paged_decode_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
+                           block_size: int, impl: str = "auto"):
+    """Dispatch (the op-binding seam, like ``models/layers.attention``)."""
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "pallas":
+        return paged_decode_attention_pallas(
+            q, k_cache, v_cache, block_tables, seq_lens,
+            block_size=block_size)
+    if impl == "pallas_interpret":
+        return paged_decode_attention_pallas(
+            q, k_cache, v_cache, block_tables, seq_lens,
+            block_size=block_size, interpret=True)
+    return paged_decode_attention_reference(
+        q, k_cache, v_cache, block_tables, seq_lens, block_size=block_size)
