@@ -1,29 +1,33 @@
 """Headline benchmark — training tokens/sec/chip on the flagship Llama-family model.
 
-Runs on whatever single accelerator is present (driver: one real TPU v5e chip) and
+Runs on whatever single accelerator is present (driver: one real TPU chip) and
 prints ONE JSON line. ``vs_baseline`` compares achieved model-FLOPs utilization to
 the reference's best published sustained utilization — DeepSpeed-Ulysses' 175
 TFLOPs/GPU on A100 = 54% of bf16 peak (``blogs/deepspeed-ulysses/README.md:82``,
 mirrored in BASELINE.md) — i.e. vs_baseline > 1 means we sustain a larger fraction
 of our chip's peak than the reference does of its chip's.
+
+Resilience contract (round-1 postmortem: BENCH_r01.json rc=1 on TPU backend
+init): this script ALWAYS exits 0 and ALWAYS prints one valid JSON line. The
+parent process runs the actual benchmark in a child subprocess; if the child
+dies on backend init it is retried once (transient tunnel failures) and then
+re-run with ``JAX_PLATFORMS=''`` (auto-select) and ``JAX_PLATFORMS=cpu``
+fallbacks, degrading the platform rather than losing the round's number.
 """
 import json
+import os
+import subprocess
+import sys
 import time
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-import deepspeedsyclsupport_tpu as ds
-from deepspeedsyclsupport_tpu.models import build_model, get_config
 
 # bf16 peak FLOPs by platform (per chip)
 PEAKS = {"tpu": 197e12,   # TPU v5e
          "cpu": 1e12}     # nominal, for smoke runs off-TPU
 REFERENCE_MFU = 0.54       # Ulysses 175/312 TFLOPs on A100 (BASELINE.md)
+CHILD_ENV = "DSTPU_BENCH_CHILD"
 
 
-def model_flops_per_token(cfg) -> float:
+def model_flops_per_token(cfg):
     """6·N_active for the matmuls + attention quadratic term."""
     n_active = cfg.param_count()
     if cfg.num_experts > 0:
@@ -33,7 +37,13 @@ def model_flops_per_token(cfg) -> float:
     return 6 * n_active, attn
 
 
-def main():
+def run_bench():
+    import jax
+    import numpy as np
+
+    import deepspeedsyclsupport_tpu as ds
+    from deepspeedsyclsupport_tpu.models import build_model, get_config
+
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
     if on_tpu:
@@ -43,7 +53,7 @@ def main():
         name, seq, micro_bs, steps = "tiny", 256, 8, 4
         cfg = get_config(name)
 
-    model = build_model(cfg) if not isinstance(cfg, str) else build_model(name)
+    model = build_model(cfg)
     topo = ds.build_topology(dp=1)
     config = {
         "train_batch_size": micro_bs,
@@ -85,5 +95,53 @@ def main():
     }))
 
 
+def _spawn(env_overrides):
+    env = dict(os.environ)
+    env[CHILD_ENV] = "1"
+    env.update(env_overrides)
+    try:
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              capture_output=True, text=True, timeout=3000,
+                              env=env)
+    except subprocess.TimeoutExpired as e:
+        return None, f"timeout: {e}"
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+            if isinstance(parsed, dict) and "metric" in parsed:
+                return line, None
+        except json.JSONDecodeError:
+            continue
+    tail = ((proc.stderr or "") + (proc.stdout or ""))[-2000:]
+    return None, f"rc={proc.returncode}: {tail}"
+
+
+def main():
+    attempts = [
+        {},                           # native platform (TPU when present)
+        {},                           # once more: transient backend-init blips
+        {"JAX_PLATFORMS": ""},        # let jax auto-select any live backend
+        {"JAX_PLATFORMS": "cpu"},     # guaranteed-available degraded run
+    ]
+    errors = []
+    for overrides in attempts:
+        line, err = _spawn(overrides)
+        if line is not None:
+            print(line)
+            return
+        errors.append(err)
+    print(json.dumps({
+        "metric": "train_tokens_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "detail": {"platform": "none", "error": (errors[-1] or "")[-500:]},
+    }))
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get(CHILD_ENV):
+        run_bench()
+    else:
+        main()
+        sys.exit(0)

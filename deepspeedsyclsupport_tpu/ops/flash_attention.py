@@ -1,17 +1,388 @@
-"""Pallas flash attention (placeholder seam).
+"""Pallas flash attention — fused streaming-softmax attention, fwd + bwd.
 
-Will hold the fused streaming-softmax attention kernel (reference analog:
-``csrc/transformer/inference/csrc/`` fused attention + ``evoformer_attn``;
-SURVEY.md §2.5 "TPU plan: Pallas flash-attention variants"). Until the kernel
-lands, raises NotImplementedError so ``models.layers.attention`` falls back to
-the exact jnp reference.
+The training/prefill attention kernel: the TPU-native answer to the
+reference's fused-attention native code (v1 inference fused softmax/attention
+``csrc/transformer/inference/csrc/``, the CUTLASS EvoformerAttention family
+``csrc/deepspeed4science/evoformer_attn/`` ~14.9k LoC, and v2's
+``blocked_flash``). One kernel family, three Pallas kernels total:
+
+* forward: grid (batch, q_head, q_block, kv_block) with the kv dimension
+  innermost-sequential; online-softmax state (m, l, acc) lives in VMEM
+  scratch that persists across the kv sweep, so logits are never
+  materialized in HBM — O(S) memory vs the O(S²) jnp reference.
+* backward: the standard two-kernel split — dQ accumulates over kv blocks,
+  dK/dV accumulate over q blocks — recomputing probabilities from the saved
+  per-row logsumexp (flash-attention-2 style), wired as a ``jax.custom_vjp``.
+* GQA: kv blocks are indexed by ``q_head // group`` in the BlockSpec index
+  map, so grouped q heads stream the same KV block out of HBM once; the
+  backward produces per-q-head dK/dV and group-sums outside the kernel.
+
+Masking supports causal (with Sq != Skv offsets), packed-sequence
+``segment_ids``, and length padding (sequences pad to block multiples, the
+pad region is masked). Off-TPU the kernels run in interpret mode, which is
+also how the parity tests exercise them (SURVEY.md §4 pattern).
 """
+import functools
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128
+
+__all__ = ["flash_attention"]
 
 
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _mask(i, j, seg_q, seg_k, *, causal, offset, q_len, kv_len,
+          block_q, block_k):
+    """[block_q, block_k] validity mask for tile (i, j)."""
+    q_pos = i * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    m = jnp.logical_and(q_pos < q_len, k_pos < kv_len)
+    if causal:
+        m = jnp.logical_and(m, k_pos <= q_pos + offset)
+    m = jnp.logical_and(m, seg_q == seg_k)  # (bq,1) vs (1,bk) broadcast
+    return m
+
+
+# ------------------------------------------------------------------- forward
+def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref,   # inputs
+                o_ref, lse_ref,                        # outputs
+                m_scr, l_scr, acc_scr,                 # scratch
+                *, scale, causal, offset, q_len, kv_len,
+                block_q, block_k, num_kv_blocks):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = _mask(i, j, sq_ref[0], sk_ref[0], causal=causal, offset=offset,
+                     q_len=q_len, kv_len=kv_len, block_q=block_q,
+                     block_k=block_k)
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev, l_prev = m_scr[...], l_scr[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)          # [bq, 1]
+        m_next = jnp.maximum(m_prev, m_cur)                # [bq, LANES]
+        alpha = jnp.exp(m_prev - m_next)
+        # masked-out entries must stay 0 even when the whole row is masked
+        # (NEG_INF - NEG_INF == 0 would otherwise exp to 1)
+        p = jnp.where(mask, jnp.exp(s - m_next[:, :1]), 0.0)
+        l_scr[...] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_scr[...] = m_next
+        pv = jax.lax.dot_general(p, v_ref[0, 0].astype(jnp.float32),
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[...] = acc_scr[...] * alpha[:, :1] + pv
+
+    if causal:
+        # tiles strictly above the shifted diagonal contribute nothing
+        @pl.when((i + 1) * block_q - 1 + offset >= j * block_k)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(j == num_kv_blocks - 1)
+    def _():
+        l = l_scr[...][:, :1]
+        o_ref[0, 0] = (acc_scr[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_scr[...][:, :1] + jnp.log(jnp.maximum(l, 1e-30))
+
+
+# ------------------------------------------------------------------ backward
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
+               dq_ref, dq_scr,
+               *, scale, causal, offset, q_len, kv_len,
+               block_q, block_k, num_kv_blocks):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = _mask(i, j, sq_ref[0], sk_ref[0], causal=causal, offset=offset,
+                     q_len=q_len, kv_len=kv_len, block_q=block_q,
+                     block_k=block_k)
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0, 0]), 0.0)   # [bq, bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dl_ref[0, 0])                            # [bq, bk]
+        dq_scr[...] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when((i + 1) * block_q - 1 + offset >= j * block_k)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(j == num_kv_blocks - 1)
+    def _():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr,
+                *, scale, causal, offset, q_len, kv_len,
+                block_q, block_k, num_q_blocks):
+    j = pl.program_id(2)   # kv block (outer)
+    i = pl.program_id(3)   # q block (inner, sequential accumulation)
+
+    @pl.when(i == 0)
+    def _():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = _mask(i, j, sq_ref[0], sk_ref[0], causal=causal, offset=offset,
+                     q_len=q_len, kv_len=kv_len, block_q=block_q,
+                     block_k=block_k)
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0, 0]), 0.0)   # [bq, bk]
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                 # [bk, D]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dl_ref[0, 0])
+        dk_scr[...] += scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                 # [bk, D]
+
+    if causal:
+        @pl.when((i + 1) * block_q - 1 + offset >= j * block_k)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(i == num_q_blocks - 1)
+    def _():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+# ------------------------------------------------------------- pallas_call’s
+def _fwd_call(q, k, v, seg_q, seg_k, *, scale, causal, offset, q_len, kv_len,
+              block_q, block_k, interpret):
+    b, h, sq, d = q.shape
+    kvh = k.shape[1]
+    skv = k.shape[2]
+    grid = (b, h, sq // block_q, skv // block_k)
+    g = h // kvh
+    kern = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        offset=offset, q_len=q_len, kv_len=kv_len, block_q=block_q,
+        block_k=block_k, num_kv_blocks=grid[3])
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, h, i, j: (b, h // g, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, h, i, j: (b, h // g, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, h, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, h, i, j: (b, 0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, seg_q, seg_k)
+
+
+def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, *, scale, causal, offset,
+              q_len, kv_len, block_q, block_k, interpret):
+    b, h, sq, d = q.shape
+    kvh = k.shape[1]
+    skv = k.shape[2]
+    g = h // kvh
+
+    nq, nkv = sq // block_q, skv // block_k
+    common = dict(scale=scale, causal=causal, offset=offset, q_len=q_len,
+                  kv_len=kv_len, block_q=block_q, block_k=block_k)
+    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, block_k, d),
+                           lambda b, h, i, j: (b, h // g, j, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
+    sq_spec = pl.BlockSpec((1, block_q, 1), lambda b, h, i, j: (b, i, 0))
+    sk_spec = pl.BlockSpec((1, 1, block_k), lambda b, h, i, j: (b, 0, j))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, num_kv_blocks=nkv, **common),
+        grid=(b, h, nq, nkv),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec,
+                  sq_spec, sk_spec],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta, seg_q, seg_k)
+
+    # grid reordered: kv block outer, q block inner (sequential accumulation)
+    q_spec2 = pl.BlockSpec((1, 1, block_q, d), lambda b, h, j, i: (b, h, i, 0))
+    kv_spec2 = pl.BlockSpec((1, 1, block_k, d),
+                            lambda b, h, j, i: (b, h // g, j, 0))
+    row_spec2 = pl.BlockSpec((1, 1, block_q, 1),
+                             lambda b, h, j, i: (b, h, i, 0))
+    sq_spec2 = pl.BlockSpec((1, block_q, 1), lambda b, h, j, i: (b, i, 0))
+    sk_spec2 = pl.BlockSpec((1, 1, block_k), lambda b, h, j, i: (b, 0, j))
+    dkv_out = pl.BlockSpec((1, 1, block_k, d),
+                           lambda b, h, j, i: (b, h, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, num_q_blocks=nq, **common),
+        grid=(b, h, nkv, nq),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2,
+                  sq_spec2, sk_spec2],
+        out_specs=[dkv_out, dkv_out],
+        out_shape=[jax.ShapeDtypeStruct((b, h, skv, d), jnp.float32),
+                   jax.ShapeDtypeStruct((b, h, skv, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta, seg_q, seg_k)
+    if g > 1:
+        dk = dk.reshape(b, kvh, g, skv, d).sum(axis=2)
+        dv = dv.reshape(b, kvh, g, skv, d).sum(axis=2)
+    return dq, dk, dv
+
+
+# ----------------------------------------------------------------- custom_vjp
+@functools.lru_cache(maxsize=None)
+def _make_flash(head_dim, causal, offset, q_len, kv_len, block_q, block_k,
+                interpret):
+    call_kw = dict(scale=1.0 / np.sqrt(head_dim), causal=causal,
+                   offset=offset, q_len=q_len, kv_len=kv_len,
+                   block_q=block_q, block_k=block_k, interpret=interpret)
+
+    @jax.custom_vjp
+    def f(q, k, v, seg_q, seg_k):
+        o, _ = _fwd_call(q, k, v, seg_q, seg_k, **call_kw)
+        return o
+
+    def f_fwd(q, k, v, seg_q, seg_k):
+        o, lse = _fwd_call(q, k, v, seg_q, seg_k, **call_kw)
+        return o, (q, k, v, seg_q, seg_k, o, lse)
+
+    def f_bwd(res, do):
+        q, k, v, seg_q, seg_k, o, lse = res
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1, keepdims=True)            # [B,H,Sq,1]
+        dq, dk, dv = _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k,
+                               **call_kw)
+        zero = lambda x: np.zeros(x.shape, jax.dtypes.float0)
+        return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+                zero(seg_q), zero(seg_k))
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+# -------------------------------------------------------------------- public
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True,
-                    segment_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
-    raise NotImplementedError("pallas flash attention not yet built")
+                    segment_ids: Optional[jnp.ndarray] = None,
+                    block_q: int = 512, block_k: int = 512,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Flash attention over ``q [B,Sq,H,D]``, ``k/v [B,Skv,KVH,D]``.
+
+    Differentiable (custom fwd/bwd Pallas kernels); GQA when ``KVH < H``;
+    ``segment_ids [B,S]`` masks attention across packed-sequence boundaries.
+    Returns ``[B,Sq,H,D]`` in q's dtype. Off-TPU runs in interpret mode.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, sq, h, d = q.shape
+    skv, kvh = k.shape[1], k.shape[2]
+    if h % kvh:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {kvh}")
+    offset = skv - sq
+
+    # block sizes clamp to the (padded) sequence
+    block_q = min(block_q, _round_up(sq, 128))
+    block_k = min(block_k, _round_up(skv, 128))
+    sq_p, skv_p = _round_up(sq, block_q), _round_up(skv, block_k)
+    d_p = _round_up(d, _LANES)
+
+    def pad(x, s_to, axis_s):
+        cfg = [(0, 0)] * 4
+        cfg[axis_s] = (0, s_to - x.shape[axis_s])
+        cfg[3] = (0, d_p - d)
+        return jnp.pad(x, cfg) if any(p != (0, 0) for p in cfg) else x
+
+    qt = pad(jnp.transpose(q, (0, 2, 1, 3)), sq_p, 2)     # [B,H,Sq,D]
+    kt = pad(jnp.transpose(k, (0, 2, 1, 3)), skv_p, 2)    # [B,KVH,Skv,D]
+    vt = pad(jnp.transpose(v, (0, 2, 1, 3)), skv_p, 2)
+
+    if segment_ids is None:
+        seg_q = jnp.zeros((b, sq_p, 1), jnp.int32)
+        seg_k = jnp.zeros((b, 1, skv_p), jnp.int32)
+    else:
+        if segment_ids.shape[1] == sq == skv:
+            sq_ids = sk_ids = segment_ids.astype(jnp.int32)
+        else:
+            raise ValueError("segment_ids requires Sq == Skv == ids length")
+        seg_q = jnp.pad(sq_ids, ((0, 0), (0, sq_p - sq)))[:, :, None]
+        seg_k = jnp.pad(sk_ids, ((0, 0), (0, skv_p - skv)))[:, None, :]
+
+    fn = _make_flash(int(d), bool(causal), int(offset), int(sq), int(skv),
+                     int(block_q), int(block_k), bool(interpret))
+    out = fn(qt, kt, vt, seg_q, seg_k)                    # [B,H,Sq_p,D_p]
+    out = out[:, :, :sq, :d]
+    return jnp.transpose(out, (0, 2, 1, 3))

@@ -1,0 +1,136 @@
+"""Pallas flash attention: kernel-vs-reference parity, fwd + grad (the
+CUDA-vs-torch parity pattern of the reference's kernel tests, SURVEY.md §4),
+run in interpret mode on the CPU sim."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeedsyclsupport_tpu.models.layers import reference_attention
+from deepspeedsyclsupport_tpu.ops.flash_attention import flash_attention
+
+
+def _qkv(rng, b=2, sq=256, skv=None, h=4, kvh=None, d=32, dtype=jnp.float32):
+    skv = skv if skv is not None else sq
+    kvh = kvh if kvh is not None else h
+    ks = jax.random.split(jax.random.PRNGKey(rng), 3)
+    q = jax.random.normal(ks[0], (b, sq, h, d), dtype)
+    k = jax.random.normal(ks[1], (b, skv, kvh, d), dtype)
+    v = jax.random.normal(ks[2], (b, skv, kvh, d), dtype)
+    return q, k, v
+
+
+class TestFlashForwardParity:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_basic(self, causal):
+        q, k, v = _qkv(0)
+        ref = reference_attention(q, k, v, causal=causal)
+        got = flash_attention(q, k, v, causal=causal, interpret=True,
+                              block_q=128, block_k=128)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_gqa(self):
+        q, k, v = _qkv(1, h=8, kvh=2)
+        ref = reference_attention(q, k, v, causal=True)
+        got = flash_attention(q, k, v, causal=True, interpret=True,
+                              block_q=128, block_k=128)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_unaligned_lengths(self):
+        # sequence not a multiple of the block: pad region must be masked
+        q, k, v = _qkv(2, sq=200, skv=200)
+        ref = reference_attention(q, k, v, causal=True)
+        got = flash_attention(q, k, v, causal=True, interpret=True,
+                              block_q=128, block_k=128)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_cross_lengths_causal_offset(self):
+        # Skv > Sq: queries sit at the end (chunked prefill shape)
+        q, k, v = _qkv(3, sq=128, skv=384)
+        ref = reference_attention(q, k, v, causal=True)
+        got = flash_attention(q, k, v, causal=True, interpret=True,
+                              block_q=128, block_k=128)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_segment_ids(self):
+        q, k, v = _qkv(4, sq=256)
+        seg = jnp.asarray(np.repeat([[0, 1, 2, 3]], 64, axis=1).reshape(1, 256)
+                          .repeat(2, axis=0))
+        ref = reference_attention(q, k, v, causal=True, segment_ids=seg)
+        got = flash_attention(q, k, v, causal=True, segment_ids=seg,
+                              interpret=True, block_q=128, block_k=128)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_bf16(self):
+        q, k, v = _qkv(5, dtype=jnp.bfloat16)
+        ref = reference_attention(q, k, v, causal=True)
+        got = flash_attention(q, k, v, causal=True, interpret=True,
+                              block_q=128, block_k=128)
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   rtol=2e-2, atol=2e-2)
+
+
+class TestFlashGradParity:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_grads_match_reference(self, causal):
+        q, k, v = _qkv(6, sq=256, d=32)
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, causal=causal, interpret=True,
+                                block_q=128, block_k=128)
+            return jnp.sum(o * jnp.cos(o))
+
+        def loss_ref(q, k, v):
+            o = reference_attention(q, k, v, causal=causal)
+            return jnp.sum(o * jnp.cos(o))
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_flash, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_grads_gqa_segments(self):
+        q, k, v = _qkv(7, sq=256, h=8, kvh=2)
+        seg = jnp.asarray(np.repeat([[0, 1]], 128, axis=1).reshape(1, 256)
+                          .repeat(2, axis=0))
+
+        def loss(fn):
+            def inner(q, k, v):
+                o = fn(q, k, v)
+                return jnp.sum(jnp.tanh(o))
+            return inner
+
+        g_flash = jax.grad(
+            loss(lambda q, k, v: flash_attention(
+                q, k, v, causal=True, segment_ids=seg, interpret=True,
+                block_q=128, block_k=128)), argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(
+            loss(lambda q, k, v: reference_attention(
+                q, k, v, causal=True, segment_ids=seg)),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_flash, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_grad_under_jit_and_unaligned(self):
+        q, k, v = _qkv(8, sq=200)
+
+        @jax.jit
+        def loss(q, k, v):
+            o = flash_attention(q, k, v, causal=True, interpret=True,
+                                block_q=128, block_k=128)
+            return jnp.sum(o ** 2)
+
+        g = jax.grad(loss)(q, k, v)
+        g_ref = jax.grad(
+            lambda q, k, v: jnp.sum(
+                reference_attention(q, k, v, causal=True) ** 2))(q, k, v)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                                   rtol=2e-4, atol=2e-4)
