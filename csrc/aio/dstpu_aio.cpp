@@ -28,6 +28,7 @@ struct Request {
   int64_t id;
   bool write;
   bool do_fsync;  // durability is opt-in: swap traffic skips it
+  bool do_trunc;  // whole-file rewrites only; never inferred from offset
   std::string path;
   void* buf;
   int64_t nbytes;
@@ -49,11 +50,12 @@ struct Handle {
     for (auto& t : workers) t.join();
   }
 
-  int64_t submit(bool write, bool do_fsync, const char* path, void* buf,
-                 int64_t nbytes, int64_t offset) {
+  int64_t submit(bool write, bool do_fsync, bool do_trunc, const char* path,
+                 void* buf, int64_t nbytes, int64_t offset) {
     std::lock_guard<std::mutex> lk(mu);
     int64_t id = next_id++;
-    queue.push_back(Request{id, write, do_fsync, path, buf, nbytes, offset});
+    queue.push_back(
+        Request{id, write, do_fsync, do_trunc, path, buf, nbytes, offset});
     status[id] = 0;  // pending
     cv.notify_one();
     return id;
@@ -100,8 +102,11 @@ struct Handle {
   }
 
   static int execute(const Request& req) {
+    // Truncation is an explicit per-request flag: inferring it from
+    // offset == 0 would let the offset-0 chunk of a partitioned write
+    // zero sibling chunks that already landed.
     int flags = req.write ? (O_WRONLY | O_CREAT) : O_RDONLY;
-    if (req.write && req.offset == 0) flags |= O_TRUNC;  // whole-file write
+    if (req.write && req.do_trunc) flags |= O_TRUNC;
     int fd = ::open(req.path.c_str(), flags, 0644);
     if (fd < 0) return -errno;
     char* p = static_cast<char*>(req.buf);
@@ -153,14 +158,15 @@ void dstpu_aio_free(void* h) { delete static_cast<Handle*>(h); }
 
 int64_t dstpu_aio_pread(void* h, const char* path, void* buf, int64_t nbytes,
                         int64_t offset) {
-  return static_cast<Handle*>(h)->submit(false, false, path, buf, nbytes,
-                                         offset);
+  return static_cast<Handle*>(h)->submit(false, false, false, path, buf,
+                                         nbytes, offset);
 }
 
 int64_t dstpu_aio_pwrite(void* h, const char* path, const void* buf,
-                         int64_t nbytes, int64_t offset, int do_fsync) {
-  return static_cast<Handle*>(h)->submit(true, do_fsync != 0, path,
-                                         const_cast<void*>(buf), nbytes,
+                         int64_t nbytes, int64_t offset, int do_fsync,
+                         int do_trunc) {
+  return static_cast<Handle*>(h)->submit(true, do_fsync != 0, do_trunc != 0,
+                                         path, const_cast<void*>(buf), nbytes,
                                          offset);
 }
 

@@ -16,6 +16,7 @@ Two backends behind one ``save_tree``/``load_tree`` surface:
 * **orbax** (multi-host): every host writes its addressable shards in parallel.
   Selected automatically when ``jax.process_count() > 1``.
 """
+import itertools
 import json
 import os
 from typing import Any, Dict, Optional, Tuple
@@ -48,11 +49,15 @@ def _leaf_paths(tree):
     return ["/".join(_key_str(k) for k in path) for path, _ in flat]
 
 
-def _legacy_name(name: str) -> str:
-    """Clean name → the bracketed repr older checkpoints stored
-    (``str(DictKey)`` = ``['key']``, ``str(SequenceKey)`` = ``[idx]``)."""
-    return "/".join(f"[{s}]" if s.isdigit() else f"['{s}']"
-                    for s in name.split("/"))
+def _legacy_names(name: str):
+    """Clean name → the bracketed reprs older checkpoints may have stored
+    (``str(DictKey)`` = ``['key']``, ``str(SequenceKey)`` = ``[idx]``). A
+    numeric segment is ambiguous — a dict key that is the *string* "0" was
+    stored as ``['0']``, a list index as ``[0]`` — so yield every combination."""
+    options = [([f"[{s}]", f"['{s}']"] if s.isdigit() else [f"['{s}']"])
+               for s in name.split("/")]
+    for combo in itertools.product(*options):
+        yield "/".join(combo)
 
 
 def save_tree(path: str, state: Dict[str, Any], meta: Dict[str, Any]) -> None:
@@ -124,8 +129,10 @@ def _load_native(path: str, example, shardings):
     with open(os.path.join(path, DATA_FILE), "rb") as f:
         for name, ex, sh in zip(names, ex_leaves, sh_leaves):
             if name not in by_name:
-                legacy = _legacy_name(name)  # pre-_key_str bracketed format
-                if legacy in by_name:
+                # pre-_key_str bracketed formats
+                legacy = next((c for c in _legacy_names(name) if c in by_name),
+                              None)
+                if legacy is not None:
                     name = legacy
                 else:
                     raise KeyError(f"checkpoint missing leaf {name!r}")

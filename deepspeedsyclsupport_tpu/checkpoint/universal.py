@@ -29,6 +29,7 @@ class DSTpuCheckpoint:
     ``deepspeed/checkpoint/deepspeed_checkpoint.py``)."""
 
     def __init__(self, ckpt_dir: str, tag: Optional[str] = None):
+        self._data = None  # first: __del__ may run after a failed __init__
         if tag is None:
             latest = os.path.join(ckpt_dir, "latest")
             if os.path.exists(latest):
@@ -48,8 +49,6 @@ class DSTpuCheckpoint:
         if os.path.exists(meta_path):
             with open(meta_path) as f:
                 self.meta = json.load(f)
-        self._data = None  # lazily opened data-file handle
-
     def leaf_names(self, prefix: str = "") -> List[str]:
         return [e["name"] for e in self.index if e["name"].startswith(prefix)]
 
@@ -80,7 +79,7 @@ class DSTpuCheckpoint:
         return np.frombuffer(buf, dtype=np.dtype(e["dtype"])).reshape(e["shape"])
 
     def close(self):
-        if self._data is not None:
+        if getattr(self, "_data", None) is not None:
             self._data.close()
             self._data = None
 

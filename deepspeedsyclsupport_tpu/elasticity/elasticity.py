@@ -98,7 +98,12 @@ class ElasticResult:
 def compute_elastic_config(ds_config: dict, target_deployment_size: int = None,
                            return_microbatch: bool = True) -> ElasticResult:
     """Reference ``compute_elastic_config`` (``elasticity/elasticity.py:233``):
-    resolve the elastic section against a concrete chip count."""
+    resolve the elastic section against a concrete chip count.
+
+    ``return_microbatch=False`` skips micro-batch/GAS resolution (the fields
+    come back 0), matching the reference's two return shapes — use it when the
+    deployment only needs the batch size and valid-chip-count set.
+    """
     e = dict(ds_config.get("elasticity", {}))
     if not e.get("enabled", False):
         raise ElasticityConfigError("elasticity section missing or disabled")
@@ -126,6 +131,8 @@ def compute_elastic_config(ds_config: dict, target_deployment_size: int = None,
         raise ElasticityError(
             f"deployment of {target_deployment_size} chips (dp={dp} at "
             f"mp={mp}) is not in the valid set {gpus} for batch {batch}")
+    if not return_microbatch:
+        return ElasticResult(batch, gpus, 0, 0)
     # choose the largest compatible micro batch (fewest accumulation steps)
     per_gpu = batch // dp
     micro = max((m for m in micro_batches if per_gpu % m == 0), default=None)

@@ -46,8 +46,9 @@ def get_report_lines():
     for name, builder in ALL_OPS.items():
         import os
 
-        compatible = builder.is_compatible()
-        built = compatible and os.path.exists(builder.so_path())
+        so = builder.so_path()  # None when sources are unreadable
+        compatible = so is not None and builder.is_compatible()
+        built = compatible and os.path.exists(so)
         lines.append(f"  {name:<12} compatible: {str(compatible):<5} "
                      f"built: {built}")
     lines.append("-" * 62)

@@ -27,7 +27,7 @@ class AsyncIOHandle:
                                         ctypes.c_int64]
         lib.dstpu_aio_pwrite.restype = ctypes.c_int64
         lib.dstpu_aio_pwrite.argtypes = lib.dstpu_aio_pread.argtypes + [
-            ctypes.c_int]
+            ctypes.c_int, ctypes.c_int]
         lib.dstpu_aio_wait.restype = ctypes.c_int
         lib.dstpu_aio_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.dstpu_aio_poll.restype = ctypes.c_int
@@ -43,14 +43,22 @@ class AsyncIOHandle:
             raise RuntimeError("AsyncIOHandle used after close()")
 
     def pwrite(self, path: str, arr: np.ndarray, offset: int = 0,
-               fsync: bool = False) -> int:
+               fsync: bool = False, truncate: bool = None) -> int:
         """``fsync=True`` for durability-critical writes (checkpoints); swap
-        scratch traffic keeps the default and skips the device flush."""
+        scratch traffic keeps the default and skips the device flush.
+
+        ``truncate`` is the whole-file-rewrite flag: it defaults to True only
+        for the no-offset call shape (the common rewrite-this-file case) and
+        MUST be passed False by chunked writers that partition one file into
+        offset ranges — an offset-0 chunk must never zero sibling chunks.
+        """
         self._check_open()
+        if truncate is None:
+            truncate = offset == 0
         arr = np.ascontiguousarray(arr)
         req = self._lib.dstpu_aio_pwrite(
             self._h, path.encode(), arr.ctypes.data_as(ctypes.c_void_p),
-            arr.nbytes, offset, 1 if fsync else 0)
+            arr.nbytes, offset, 1 if fsync else 0, 1 if truncate else 0)
         self._inflight[req] = arr
         return req
 

@@ -50,11 +50,23 @@ class OpBuilder:
         return which(self.compiler()) is not None
 
     # ------------------------------------------------------------------ build
-    def _source_hash(self) -> str:
+    def _source_hash(self) -> Optional[str]:
+        """Hash of sources + flags + compiler identity, cached per instance.
+
+        None when sources are unreadable (e.g. an installed wheel without
+        ``csrc/``) — callers report unbuilt/incompatible instead of crashing.
+        """
+        cached = getattr(self, "_hash_cache", False)
+        if cached is not False:
+            return cached
         h = hashlib.sha256()
-        for s in self.sources():
-            with open(s, "rb") as f:
-                h.update(f.read())
+        try:
+            for s in self.sources():
+                with open(s, "rb") as f:
+                    h.update(f.read())
+        except OSError:
+            self._hash_cache = None
+            return None
         h.update(" ".join(self.extra_flags()).encode())
         # compiler identity: switching CXX (or upgrading it) must rebuild
         h.update(self.compiler().encode())
@@ -63,15 +75,21 @@ class OpBuilder:
                                     capture_output=True).stdout)
         except OSError:
             pass
-        return h.hexdigest()[:16]
+        self._hash_cache = h.hexdigest()[:16]
+        return self._hash_cache
 
-    def so_path(self) -> str:
-        return os.path.join(_CACHE_DIR,
-                            f"{self.NAME}_{self._source_hash()}.so")
+    def so_path(self) -> Optional[str]:
+        src_hash = self._source_hash()
+        if src_hash is None:
+            return None
+        return os.path.join(_CACHE_DIR, f"{self.NAME}_{src_hash}.so")
 
     def jit_load(self) -> str:
         """Compile if the hashed .so is absent (reference ``jit_load:480``)."""
         out = self.so_path()
+        if out is None:
+            raise OpBuilderError(
+                f"op {self.NAME!r}: sources unreadable ({self.sources()})")
         if os.path.exists(out):
             return out
         if not self.is_compatible():

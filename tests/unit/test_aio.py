@@ -171,6 +171,20 @@ class TestSwapper:
         handle.wait(handle.pwrite(path, np.ones((100,), np.uint8)))
         assert os.path.getsize(path) == 100
 
+    def test_chunked_offset_writes_no_truncate(self, handle, tmp_path):
+        """Partitioned offset writes to one file must not zero sibling chunks
+        even when the offset-0 chunk lands last (regression: O_TRUNC was
+        inferred from offset==0)."""
+        path = str(tmp_path / "chunked.bin")
+        chunk_b = np.full((1000,), 2, np.uint8)
+        chunk_a = np.full((1000,), 1, np.uint8)
+        handle.wait(handle.pwrite(path, chunk_b, offset=1000, truncate=False))
+        handle.wait(handle.pwrite(path, chunk_a, offset=0, truncate=False))
+        out = np.empty((2000,), np.uint8)
+        handle.wait(handle.pread(path, out))
+        np.testing.assert_array_equal(out[:1000], chunk_a)
+        np.testing.assert_array_equal(out[1000:], chunk_b)
+
     def test_poll_failure_reaps(self, handle, tmp_path):
         out = np.empty((4,), np.float32)
         req = handle.pread(str(tmp_path / "missing.bin"), out)
