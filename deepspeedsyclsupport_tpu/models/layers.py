@@ -133,10 +133,13 @@ def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """Attention dispatch — the seam where Pallas/SP implementations plug in
     (reference analog: the op-binding indirection of
     ``ops/transformer/inference/op_binding/``)."""
+    if kv_positions_below is not None or kv_mask is not None:
+        # cached-decode masking: only the xla reference implements slot-space
+        # masks. flash/ring/ulysses are training/prefill patterns — routing
+        # them here would silently drop the mask and attend to garbage slots.
+        impl = "xla"
     if impl == "auto":
-        impl = "flash" if (jax.default_backend() == "tpu"
-                           and kv_positions_below is None
-                           and kv_mask is None) else "xla"
+        impl = "flash" if jax.default_backend() == "tpu" else "xla"
     if impl == "flash":
         from ..ops.flash_attention import flash_attention
 
@@ -151,7 +154,8 @@ def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if impl == "ulysses":
         from ..parallel.ulysses import ulysses_attention
 
-        return ulysses_attention(q, k, v, causal=causal)
+        return ulysses_attention(q, k, v, causal=causal,
+                                 segment_ids=segment_ids)
     return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids,
                                kv_positions_below=kv_positions_below,
                                kv_mask=kv_mask)

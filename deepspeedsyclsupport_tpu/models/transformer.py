@@ -139,7 +139,9 @@ class CausalLM:
             positions = jnp.broadcast_to(positions, (b, s))
         rng = rng if rng is not None else jax.random.PRNGKey(0)
 
-        x = jnp.take(params["embed"]["embedding"], input_ids, axis=0)
+        from ..parallel.tensor_parallel import vocab_parallel_embedding
+
+        x = vocab_parallel_embedding(params["embed"]["embedding"], input_ids)
         x = x.astype(jnp.dtype(cfg.dtype))
         x = constrain(x, BATCH, "seq", None)
 

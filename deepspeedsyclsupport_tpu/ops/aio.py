@@ -43,18 +43,16 @@ class AsyncIOHandle:
             raise RuntimeError("AsyncIOHandle used after close()")
 
     def pwrite(self, path: str, arr: np.ndarray, offset: int = 0,
-               fsync: bool = False, truncate: bool = None) -> int:
+               fsync: bool = False, truncate: bool = False) -> int:
         """``fsync=True`` for durability-critical writes (checkpoints); swap
         scratch traffic keeps the default and skips the device flush.
 
-        ``truncate`` is the whole-file-rewrite flag: it defaults to True only
-        for the no-offset call shape (the common rewrite-this-file case) and
-        MUST be passed False by chunked writers that partition one file into
-        offset ranges — an offset-0 chunk must never zero sibling chunks.
+        ``truncate=True`` is the whole-file-rewrite flag. It is never inferred:
+        an offset-0 chunk of a partitioned multi-chunk write must not zero
+        sibling chunks, so chunked writers get safe behavior by default and
+        whole-file rewriters opt in explicitly.
         """
         self._check_open()
-        if truncate is None:
-            truncate = offset == 0
         arr = np.ascontiguousarray(arr)
         req = self._lib.dstpu_aio_pwrite(
             self._h, path.encode(), arr.ctypes.data_as(ctypes.c_void_p),

@@ -56,8 +56,8 @@ class OpBuilder:
         None when sources are unreadable (e.g. an installed wheel without
         ``csrc/``) — callers report unbuilt/incompatible instead of crashing.
         """
-        cached = getattr(self, "_hash_cache", False)
-        if cached is not False:
+        cached = getattr(self, "_hash_cache", None)
+        if cached is not None:
             return cached
         h = hashlib.sha256()
         try:
@@ -65,8 +65,7 @@ class OpBuilder:
                 with open(s, "rb") as f:
                     h.update(f.read())
         except OSError:
-            self._hash_cache = None
-            return None
+            return None  # transient or missing — re-probe next call
         h.update(" ".join(self.extra_flags()).encode())
         # compiler identity: switching CXX (or upgrading it) must rebuild
         h.update(self.compiler().encode())

@@ -14,5 +14,6 @@ from .pipeline import (InferenceSchedule, PipelineModule,  # noqa: F401
                        TrainSchedule, partition_balanced, partition_uniform,
                        spmd_pipeline)
 from .ring_attention import ring_attention  # noqa: F401
-from .tensor_parallel import auto_tp_rules, column_parallel, row_parallel  # noqa: F401
+from .tensor_parallel import (auto_tp_rules, column_parallel,  # noqa: F401
+                              row_parallel, vocab_parallel_embedding)
 from .ulysses import ulysses_attention  # noqa: F401

@@ -65,3 +65,58 @@ def column_parallel(*, stacked: bool = False) -> Tuple:
 def row_parallel(*, stacked: bool = False) -> Tuple:
     """Spec for a [in, out] weight split on in (Megatron RowParallelLinear)."""
     return ((None,) if stacked else ()) + ("model", "fsdp")
+
+
+def vocab_parallel_embedding(table, input_ids):
+    """Embedding lookup over a vocab-sharded table (Megatron
+    VocabParallelEmbedding; reference analog: the sharded word-embedding
+    containers in ``module_inject/``).
+
+    A plain ``jnp.take`` on a table sharded ('model', 'fsdp') defeats the SPMD
+    partitioner — it replicates the table then re-partitions ("involuntary full
+    rematerialization"). This issues the Megatron pattern explicitly in a
+    shard_map: each device looks up only ids inside its local vocab range,
+    zero-fills the rest, and a psum over ``model`` combines; the hidden shards
+    are all-gathered over ``fsdp``.
+
+    table: [V, H] sharded ('model', 'fsdp'); input_ids: [B, S] sharded
+    (('data','fsdp'), 'seq'). Returns [B, S, H] in the activation layout.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..comm import topology as topo_mod
+
+    topo = topo_mod._WORLD_TOPOLOGY
+    tp = topo.axis_sizes.get("model", 1) if topo is not None else 1
+    try:
+        in_manual_region = lax.axis_size("model") > 0
+    except NameError:
+        in_manual_region = False
+    sizes = topo.axis_sizes if topo is not None else {}
+    bdiv = sizes.get("data", 1) * sizes.get("fsdp", 1)
+    divisible = (topo is not None
+                 and input_ids.shape[0] % bdiv == 0
+                 and input_ids.shape[1] % sizes.get("seq", 1) == 0
+                 and table.shape[0] % tp == 0
+                 and table.shape[1] % sizes.get("fsdp", 1) == 0)
+    if topo is None or tp == 1 or in_manual_region or not divisible:
+        return jnp.take(table, input_ids, axis=0)
+
+    def body(tbl, ids):
+        # tbl: [V/tp, H/fsdp]; ids: [B/(data·fsdp), S/sp]
+        vstart = lax.axis_index("model") * tbl.shape[0]
+        local = ids - vstart
+        ok = jnp.logical_and(local >= 0, local < tbl.shape[0])
+        x = jnp.take(tbl, jnp.where(ok, local, 0), axis=0)
+        x = jnp.where(ok[..., None], x, jnp.zeros_like(x))
+        x = lax.psum(x, "model")
+        return lax.all_gather(x, "fsdp", axis=2, tiled=True)
+
+    return jax.shard_map(
+        body, mesh=topo.mesh,
+        in_specs=(P("model", "fsdp"), P(("data", "fsdp"), "seq")),
+        out_specs=P(("data", "fsdp"), "seq", None),
+        check_vma=False)(table, input_ids)

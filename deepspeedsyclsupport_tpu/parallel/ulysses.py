@@ -1,51 +1,126 @@
 """Ulysses sequence parallelism (DeepSpeed-Ulysses), TPU-native.
 
-The reference's ``DistributedAttention`` (``deepspeed/sequence/layer.py:60``) wraps
-any attention with two explicit all-to-alls over the sequence process group:
-scatter heads / gather sequence before local attention (``_SeqAllToAll:44``,
-``single_all_to_all:15``), and the inverse after. Here the same data movement is
-*declared*: activations arrive sequence-sharded ``[B, S/sp, H, D]``; re-constraining
-to head-sharded ``[B, S, H/(sp·tp), D]`` makes the SPMD partitioner emit exactly the
-all-to-all over the ``seq`` ICI axis, fused and overlapped by XLA — no hand-rolled
-autograd op, and the backward all-to-alls fall out of AD.
+The reference's ``DistributedAttention`` (``deepspeed/sequence/layer.py:60``)
+wraps any attention with two explicit all-to-alls over the sequence process
+group: scatter heads / gather sequence before local attention
+(``_SeqAllToAll:44``, ``single_all_to_all:15``), and the inverse after.
 
-Requirement (same as the reference, ``sequence/layer.py`` assert): total heads must
-be divisible by sp·tp.
+This implementation issues the same two explicit all-to-alls with
+``jax.lax.all_to_all`` inside a ``shard_map`` over the ``seq`` mesh axis.
+An earlier version *declared* the layout change with a pair of
+``with_sharding_constraint`` calls and let the SPMD partitioner infer the
+collective — correct, but the partitioner lowered it as replicate-then-
+repartition ("involuntary full rematerialization"), throwing away exactly
+the traffic saving Ulysses exists for. Explicit ``all_to_all`` lowers to the
+single fused ICI collective, and the backward all-to-alls fall out of AD
+(``lax.all_to_all`` is its own transpose up to axis swap, the role of the
+reference's symmetric ``_SeqAllToAll.backward``).
+
+Requirement (same as the reference's assert in ``sequence/layer.py``): query
+and kv head counts must be divisible by sp·tp.
 """
+from functools import partial
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
+import numpy as np
+from jax import lax
 
-from ..models.layers import BATCH, constrain, reference_attention
+from ..models.layers import reference_attention
+
+
+def _local_attention(q, k, v, causal, segment_ids, inner):
+    """Per-device attention over the full sequence with a head slice."""
+    if inner is None:
+        inner = "flash" if jax.default_backend() == "tpu" else "xla"
+    if inner == "flash":
+        from ..ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+    return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+
+
+def _seq_all_to_all_body(q, k, v, segment_ids, *, causal, inner):
+    """shard_map body: shards arrive [B/b, S/sp, H/tp, D] (seg: [B/b, S/sp]).
+
+    all-to-all #1 over ``seq`` scatters heads / gathers sequence
+    (→ [B/b, S, H/(sp·tp), D]); local attention sees the full sequence so
+    causality and segment masking are exact; all-to-all #2 inverts.
+    """
+    from .. import comm
+
+    q = comm.all_to_all(q, "seq", split_axis=2, concat_axis=1)
+    k = comm.all_to_all(k, "seq", split_axis=2, concat_axis=1)
+    v = comm.all_to_all(v, "seq", split_axis=2, concat_axis=1)
+    if segment_ids is not None:
+        segment_ids = comm.all_gather(segment_ids, "seq", axis=1)
+    out = _local_attention(q, k, v, causal, segment_ids, inner)
+    return comm.all_to_all(out, "seq", split_axis=1, concat_axis=2)
 
 
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       causal: bool = True,
                       segment_ids: Optional[jnp.ndarray] = None,
                       inner: Optional[str] = None) -> jnp.ndarray:
-    """q: [B, S, H, D] (logically global; physically sequence-sharded over 'seq').
+    """q: [B, S, H, D], k/v: [B, S, KVH, D] (logically global; physically
+    sequence-sharded over ``seq`` and head-sharded over ``model``).
 
-    head-scatter/seq-gather → local attention (full sequence, head slice) →
-    seq-scatter/head-gather.
+    head-scatter/seq-gather all-to-all → local attention (full sequence,
+    head slice) → seq-scatter/head-gather all-to-all.
     """
-    # incoming layout: sequence split over 'seq', heads split over 'model'
-    q = constrain(q, BATCH, "seq", "model", None)
-    k = constrain(k, BATCH, "seq", "model", None)
-    v = constrain(v, BATCH, "seq", "model", None)
+    from ..comm import topology as topo_mod
 
-    # all-to-all #1: gather sequence, scatter heads over (model, seq)
-    q = constrain(q, BATCH, None, ("model", "seq"), None)
-    k = constrain(k, BATCH, None, ("model", "seq"), None)
-    v = constrain(v, BATCH, None, ("model", "seq"), None)
+    topo = topo_mod._WORLD_TOPOLOGY
+    sp = topo.axis_sizes.get("seq", 1) if topo is not None else 1
 
-    if inner == "flash":
-        from ..ops.flash_attention import flash_attention
+    try:
+        bound = lax.axis_size("seq") > 0  # inside an enclosing shard_map?
+    except NameError:
+        bound = False
+    if bound:
+        # already in a manual-sharding region that binds ``seq`` — the caller
+        # holds per-device shards, so issue the collectives directly.
+        return _seq_all_to_all_body(q, k, v, segment_ids, causal=causal,
+                                    inner=inner)
 
-        out = flash_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+    if topo is None or sp == 1:
+        return _local_attention(q, k, v, causal, segment_ids, inner)
+
+    tp = topo.axis_sizes.get("model", 1)
+    g = sp * tp
+    h, kvh = q.shape[2], k.shape[2]
+    if h % g:
+        raise ValueError(
+            f"ulysses needs q heads ({h}) divisible by sp*tp ({sp}*{tp}) — "
+            f"reference sequence/layer.py has the same constraint")
+    if kvh % g:
+        # GQA with fewer kv heads than sp·tp: replicate kv heads up to the lcm
+        # so every device owns a whole head after the scatter. consecutive
+        # repetition preserves the q→kv group mapping; costs (lcm/kvh)× extra
+        # KV bytes on the wire, the unavoidable GQA-under-Ulysses trade.
+        r = np.lcm(kvh, g) // kvh
+        if (kvh * r) and h % (kvh * r) == 0:
+            k = jnp.repeat(k, r, axis=2)
+            v = jnp.repeat(v, r, axis=2)
+            kvh *= r
+        else:
+            raise ValueError(
+                f"ulysses cannot align kv heads ({k.shape[2]}) with sp*tp "
+                f"({sp}*{tp}) for q heads {h}")
+
+    from jax.sharding import PartitionSpec as P
+
+    batch = ("data", "fsdp")
+    qkv_spec = P(batch, "seq", "model", None)
+    specs_in = [qkv_spec, qkv_spec, qkv_spec]
+    args = [q, k, v]
+    if segment_ids is not None:
+        specs_in.append(P(batch, "seq"))
+        args.append(segment_ids)
+        body = partial(_seq_all_to_all_body, causal=causal, inner=inner)
     else:
-        out = reference_attention(q, k, v, causal=causal,
-                                  segment_ids=segment_ids)
-
-    # all-to-all #2: back to sequence-sharded, heads gathered
-    out = constrain(out, BATCH, None, ("model", "seq"), None)
-    return constrain(out, BATCH, "seq", "model", None)
+        body = lambda a, b, c: _seq_all_to_all_body(a, b, c, None,
+                                                    causal=causal, inner=inner)
+    return jax.shard_map(body, mesh=topo.mesh, in_specs=tuple(specs_in),
+                         out_specs=qkv_spec, check_vma=False)(*args)

@@ -159,6 +159,17 @@ class Engine:
             opt_shapes, self.params, self.param_shardings, self.topology, stage)
         self.opt_state = jax.jit(
             tx.init, out_shardings=self.opt_shardings)(self.params)
+        # Stage >= 2: gradients (and the fp32 grad accumulator the scan carries)
+        # live fsdp-sharded — the reference's IPG reduce-scatter bucketing
+        # (``stage_1_and_2.py:894,1004``). The layout is exactly the stage-3
+        # param layout (TP dims composed, largest free dim over fsdp), enforced
+        # by a sharding constraint at the microbatch boundary so XLA
+        # reduce-scatters each microbatch's grads instead of carrying a
+        # replicated full-size accumulator.
+        self.grad_shardings = None
+        if stage >= 2 and self.topology.axis_sizes["fsdp"] > 1:
+            self.grad_shardings = zero_lib.tree_param_shardings(
+                params, self.topology, 3, extra_rules=sharding_rules)
         log_dist(zero_lib.describe_memory_plan(self.params, self.topology, stage))
 
         # ---------------------------------------------------------- step fns
@@ -212,6 +223,8 @@ class Engine:
 
         (_, (loss, metrics)), grads = jax.value_and_grad(
             scaled_loss, has_aux=True)(params)
+        if self.grad_shardings is not None:
+            grads = jax.lax.with_sharding_constraint(grads, self.grad_shardings)
         return loss, metrics, grads
 
     def _apply_grads(self, params, opt_state, scaler, grads):
@@ -251,8 +264,14 @@ class Engine:
                 acc = jax.tree_util.tree_map(jnp.add, acc, grads)
                 return (acc, i + 1), (loss, metrics)
 
-            zero_grads = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            if self.grad_shardings is not None:
+                zero_grads = jax.tree_util.tree_map(
+                    lambda p, s: jax.lax.with_sharding_constraint(
+                        jnp.zeros(p.shape, jnp.float32), s),
+                    params, self.grad_shardings)
+            else:
+                zero_grads = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
             if gas == 1:
                 loss, metrics, grads = self._micro_grads(params, batch, rng, scaler)
                 losses = loss[None]

@@ -66,7 +66,8 @@ class AsyncTensorSwapper:
                     except OSError:
                         pass
         e = _SwapEntry(path=path, shape=arr.shape, dtype=arr.dtype)
-        e.write_req = self.handle.pwrite(path, arr)
+        # whole-file rewrite: a shrinking tensor must not leave stale tail bytes
+        e.write_req = self.handle.pwrite(path, arr, truncate=True)
         self._entries[name] = e
 
     # ------------------------------------------------------------------- in

@@ -181,6 +181,8 @@ def describe_memory_plan(params, topo: MeshTopology, stage: int) -> str:
     n_params = sum(math.prod(np.shape(p)) for p in jax.tree_util.tree_leaves(params))
     n = topo.axis_sizes["fsdp"]
     param_factor = n if stage >= 3 and n > 1 else 1
+    grad_factor = n if stage >= 2 and n > 1 else 1
     opt_factor = n if stage >= 1 and n > 1 else 1
     return (f"ZeRO stage {stage}: {n_params / 1e6:.1f}M params, fsdp={n}; "
-            f"param mem 1/{param_factor}, optimizer mem 1/{opt_factor} per device")
+            f"param mem 1/{param_factor}, grad mem 1/{grad_factor}, "
+            f"optimizer mem 1/{opt_factor} per device")

@@ -165,21 +165,24 @@ class TestSwapper:
         sw.close()
 
     def test_shrinking_rewrite_truncates(self, handle, tmp_path):
-        """offset-0 writes truncate (regression: stale tail bytes)."""
+        """explicit truncate=True drops stale tail bytes."""
         path = str(tmp_path / "shrink.bin")
-        handle.wait(handle.pwrite(path, np.zeros((1000,), np.uint8)))
-        handle.wait(handle.pwrite(path, np.ones((100,), np.uint8)))
+        handle.wait(handle.pwrite(path, np.zeros((1000,), np.uint8),
+                                  truncate=True))
+        handle.wait(handle.pwrite(path, np.ones((100,), np.uint8),
+                                  truncate=True))
         assert os.path.getsize(path) == 100
 
     def test_chunked_offset_writes_no_truncate(self, handle, tmp_path):
         """Partitioned offset writes to one file must not zero sibling chunks
         even when the offset-0 chunk lands last (regression: O_TRUNC was
-        inferred from offset==0)."""
+        inferred from offset==0). Non-truncation is the DEFAULT — the natural
+        chunked-writer call shape is safe without extra flags."""
         path = str(tmp_path / "chunked.bin")
         chunk_b = np.full((1000,), 2, np.uint8)
         chunk_a = np.full((1000,), 1, np.uint8)
-        handle.wait(handle.pwrite(path, chunk_b, offset=1000, truncate=False))
-        handle.wait(handle.pwrite(path, chunk_a, offset=0, truncate=False))
+        handle.wait(handle.pwrite(path, chunk_b, offset=1000))
+        handle.wait(handle.pwrite(path, chunk_a, offset=0))
         out = np.empty((2000,), np.uint8)
         handle.wait(handle.pread(path, out))
         np.testing.assert_array_equal(out[:1000], chunk_a)
