@@ -1,4 +1,6 @@
 from .engine import load_tree, save_tree  # noqa: F401
+from .hf import (HFCheckpointSource, config_from_hf,  # noqa: F401
+                 load_hf_checkpoint)
 from .universal import DSTpuCheckpoint, load_state_dict  # noqa: F401
 from .zero_to_fp32 import (  # noqa: F401
     convert_zero_checkpoint_to_fp32_state_dict,

@@ -26,6 +26,7 @@ Ragged batches are right-padded; correctness under padding comes from explicit
 slot-validity masks (see :meth:`InferenceEngine._generate_fn`), the same masking
 contract the v2 ragged engine gets from its atom builder.
 """
+import os
 from functools import partial
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -51,6 +52,37 @@ def init_inference(model: Any = None,
     (host or device). kwargs merge into config (reference allows both styles).
     """
     cfg = DSTpuInferenceConfig.from_config(config, **kwargs)
+    if isinstance(model, str):
+        # HF checkpoint directory (reference: init_inference over an HF model
+        # + checkpoint dict; here the policy/name-map layer loads it directly).
+        # Streaming discipline: build the model skeleton from config.json,
+        # derive the serving shardings from shapes alone, then stream each
+        # leaf straight to its target sharding at the serving dtype — the
+        # full model never materializes on one device or at fp32.
+        if not os.path.isdir(model):
+            raise FileNotFoundError(
+                f"init_inference(model=...) got a string that is not a local "
+                f"checkpoint directory: {model!r} (hub names are not "
+                f"downloaded here — pass a downloaded snapshot path)")
+        import json as _json
+
+        from ..checkpoint.hf import config_from_hf, load_hf_checkpoint
+        from ..models.transformer import CausalLM
+        from ..runtime import zero as zero_lib
+
+        with open(os.path.join(model, "config.json")) as f:
+            skeleton = CausalLM(config_from_hf(
+                _json.load(f), dtype=jnp.dtype(cfg.dtype).name))
+        tp = (cfg.tensor_parallel.tp_size
+              if cfg.tensor_parallel.enabled else 1)
+        topology = build_topology(dp=-1, tp=tp)
+        shapes = jax.eval_shape(skeleton.init_params)
+        shardings = zero_lib.tree_param_shardings(
+            shapes, topology, stage=0, extra_rules=skeleton.sharding_rules)
+        model, params = load_hf_checkpoint(model, model=skeleton,
+                                           dtype=cfg.dtype,
+                                           shardings=shardings)
+        return InferenceEngine(model, params, cfg, topology=topology)
     if model is None:
         raise ValueError("init_inference needs a model")
     if params is None:

@@ -1,0 +1,293 @@
+"""HF checkpoint ingestion tests (reference analogs: ``tests/unit/inference``
+checkpoint-loading paths and the module_inject policy coverage — here the
+policy is a name map, so the test fabricates a real HF-format checkpoint on
+disk and proves both engines serve those exact weights)."""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from deepspeedsyclsupport_tpu.checkpoint.hf import (config_from_hf,
+                                                    load_hf_checkpoint)
+from deepspeedsyclsupport_tpu.comm.topology import build_topology
+
+HIDDEN, LAYERS, HEADS, KVHEADS, VOCAB, INTER = 32, 2, 4, 2, 128, 64
+
+
+def tiny_hf_config(**over):
+    cfg = {
+        "model_type": "llama",
+        "vocab_size": VOCAB,
+        "hidden_size": HIDDEN,
+        "intermediate_size": INTER,
+        "num_hidden_layers": LAYERS,
+        "num_attention_heads": HEADS,
+        "num_key_value_heads": KVHEADS,
+        "max_position_embeddings": 256,
+        "rope_theta": 10000.0,
+        "rms_norm_eps": 1e-5,
+        "tie_word_embeddings": False,
+        "hidden_act": "silu",
+    }
+    cfg.update(over)
+    return cfg
+
+
+def fabricate_hf_checkpoint(path, moe=False, fmt="safetensors", seed=0):
+    """Write a tiny random HF-format llama/mixtral checkpoint directory."""
+    os.makedirs(path, exist_ok=True)
+    g = torch.Generator().manual_seed(seed)
+
+    def w(*shape):
+        return torch.randn(*shape, generator=g) * 0.05
+
+    hd = HIDDEN // HEADS
+    sd = {"model.embed_tokens.weight": w(VOCAB, HIDDEN),
+          "model.norm.weight": torch.ones(HIDDEN) + w(HIDDEN) * 0.1,
+          "lm_head.weight": w(VOCAB, HIDDEN)}
+    for i in range(LAYERS):
+        pre = f"model.layers.{i}."
+        sd[pre + "input_layernorm.weight"] = torch.ones(HIDDEN)
+        sd[pre + "post_attention_layernorm.weight"] = torch.ones(HIDDEN)
+        sd[pre + "self_attn.q_proj.weight"] = w(HEADS * hd, HIDDEN)
+        sd[pre + "self_attn.k_proj.weight"] = w(KVHEADS * hd, HIDDEN)
+        sd[pre + "self_attn.v_proj.weight"] = w(KVHEADS * hd, HIDDEN)
+        sd[pre + "self_attn.o_proj.weight"] = w(HIDDEN, HEADS * hd)
+        if moe:
+            sd[pre + "block_sparse_moe.gate.weight"] = w(4, HIDDEN)
+            for e in range(4):
+                ep = pre + f"block_sparse_moe.experts.{e}."
+                sd[ep + "w1.weight"] = w(INTER, HIDDEN)
+                sd[ep + "w3.weight"] = w(INTER, HIDDEN)
+                sd[ep + "w2.weight"] = w(HIDDEN, INTER)
+        else:
+            sd[pre + "mlp.gate_proj.weight"] = w(INTER, HIDDEN)
+            sd[pre + "mlp.up_proj.weight"] = w(INTER, HIDDEN)
+            sd[pre + "mlp.down_proj.weight"] = w(HIDDEN, INTER)
+
+    cfg = tiny_hf_config()
+    if moe:
+        cfg.update(model_type="mixtral", num_local_experts=4,
+                   num_experts_per_tok=2)
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(cfg, f)
+
+    if fmt == "safetensors":
+        from safetensors.torch import save_file
+
+        save_file(sd, os.path.join(path, "model.safetensors"))
+    elif fmt == "safetensors-sharded":
+        from safetensors.torch import save_file
+
+        names = sorted(sd)
+        half = len(names) // 2
+        parts = {"model-00001-of-00002.safetensors": names[:half],
+                 "model-00002-of-00002.safetensors": names[half:]}
+        weight_map = {}
+        for fname, keys in parts.items():
+            save_file({k: sd[k] for k in keys}, os.path.join(path, fname))
+            weight_map.update({k: fname for k in keys})
+        with open(os.path.join(path, "model.safetensors.index.json"), "w") as f:
+            json.dump({"weight_map": weight_map}, f)
+    else:  # torch bin
+        torch.save(sd, os.path.join(path, "pytorch_model.bin"))
+    return sd
+
+
+def manual_reference_logits(sd, input_ids):
+    """Independent numpy forward straight off the HF tensors — the ground
+    truth the loaded pytree must reproduce (llama graph: RMSNorm → GQA attn
+    with RoPE → SwiGLU)."""
+    x = sd["model.embed_tokens.weight"].numpy()[np.asarray(input_ids)]
+    hd = HIDDEN // HEADS
+    B, S = np.shape(input_ids)
+
+    def rms(v, scale):
+        var = (v.astype(np.float64) ** 2).mean(-1, keepdims=True)
+        return (v / np.sqrt(var + 1e-5) * scale).astype(np.float64)
+
+    def rope(v):  # [B,S,H,hd], half-split convention (models/layers.py)
+        pos = np.arange(S)[None, :, None]
+        freqs = 1.0 / 10000.0 ** (np.arange(0, hd, 2) / hd)
+        ang = pos[..., None] * freqs  # [1,S,1,hd/2]
+        c, s = np.cos(ang), np.sin(ang)
+        v1, v2 = v[..., :hd // 2], v[..., hd // 2:]
+        return np.concatenate([v1 * c - v2 * s, v2 * c + v1 * s], axis=-1)
+
+    for i in range(LAYERS):
+        pre = f"model.layers.{i}."
+        h = rms(x, sd[pre + "input_layernorm.weight"].numpy())
+        q = (h @ sd[pre + "self_attn.q_proj.weight"].numpy().T
+             ).reshape(B, S, HEADS, hd)
+        k = (h @ sd[pre + "self_attn.k_proj.weight"].numpy().T
+             ).reshape(B, S, KVHEADS, hd)
+        v = (h @ sd[pre + "self_attn.v_proj.weight"].numpy().T
+             ).reshape(B, S, KVHEADS, hd)
+        q, k = rope(q), rope(k)
+        rep = HEADS // KVHEADS
+        k = np.repeat(k, rep, axis=2)
+        v = np.repeat(v, rep, axis=2)
+        logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+        mask = np.tril(np.ones((S, S), bool))
+        logits = np.where(mask[None, None], logits, -1e30)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        attn = np.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, S, HIDDEN)
+        x = x + attn @ sd[pre + "self_attn.o_proj.weight"].numpy().T
+        h = rms(x, sd[pre + "post_attention_layernorm.weight"].numpy())
+        gate = h @ sd[pre + "mlp.gate_proj.weight"].numpy().T
+        up = h @ sd[pre + "mlp.up_proj.weight"].numpy().T
+        act = gate / (1 + np.exp(-gate)) * up
+        x = x + act @ sd[pre + "mlp.down_proj.weight"].numpy().T
+    x = rms(x, sd["model.norm.weight"].numpy())
+    return x @ sd["lm_head.weight"].numpy().T
+
+
+class TestConfigMapping:
+    def test_llama_fields(self):
+        cfg = config_from_hf(tiny_hf_config())
+        assert (cfg.vocab_size, cfg.hidden_size, cfg.num_layers) == \
+            (VOCAB, HIDDEN, LAYERS)
+        assert cfg.num_kv_heads == KVHEADS and cfg.num_experts == 0
+
+    def test_mixtral_fields(self):
+        cfg = config_from_hf(tiny_hf_config(model_type="mixtral",
+                                            num_local_experts=8,
+                                            num_experts_per_tok=2))
+        assert cfg.num_experts == 8 and cfg.num_experts_per_tok == 2
+
+    def test_unknown_activation_raises(self):
+        with pytest.raises(ValueError, match="hidden_act"):
+            config_from_hf(tiny_hf_config(hidden_act="relu6"))
+
+
+class TestLoad:
+    @pytest.mark.parametrize("fmt", ["safetensors", "safetensors-sharded",
+                                     "bin"])
+    def test_forward_matches_manual_reference(self, tmp_path, fmt):
+        """Loaded pytree must reproduce an independent numpy forward of the
+        raw HF tensors — catches transpose/mapping errors exactly."""
+        sd = fabricate_hf_checkpoint(str(tmp_path), fmt=fmt)
+        model, params = load_hf_checkpoint(str(tmp_path), dtype=jnp.float32)
+        model.config.dtype = "float32"
+        ids = np.array([[1, 9, 77, 3, 120, 14]], np.int32)
+        got = np.asarray(model.apply(params, jnp.asarray(ids)))
+        want = manual_reference_logits(sd, ids)
+        np.testing.assert_allclose(got[0], want[0], rtol=2e-3, atol=2e-3)
+
+    def test_moe_loads_and_runs(self, tmp_path):
+        fabricate_hf_checkpoint(str(tmp_path), moe=True)
+        model, params = load_hf_checkpoint(str(tmp_path), dtype=jnp.float32)
+        model.config.dtype = "float32"
+        assert model.config.num_experts == 4
+        assert params["layers"]["moe"]["w_gate"].shape == \
+            (LAYERS, 4, HIDDEN, INTER)
+        logits = model.apply(params, jnp.asarray([[5, 9, 3]], jnp.int32))
+        assert bool(jnp.isfinite(logits).all())
+
+    def test_nonscan_list_layers_with_shardings(self, tmp_path):
+        """scan_layers=False: layers are a list, sharding lookup must resolve
+        numeric path segments (regression: SequenceKey stringified as '[0]')."""
+        from deepspeedsyclsupport_tpu.runtime.zero import tree_param_shardings
+        from deepspeedsyclsupport_tpu.models.transformer import CausalLM
+
+        sd = fabricate_hf_checkpoint(str(tmp_path))
+        topo = build_topology(dp=-1, tp=2)
+        cfg = config_from_hf(tiny_hf_config(), scan_layers=False,
+                             dtype="float32")
+        model = CausalLM(cfg)
+        shapes = jax.eval_shape(model.init_params)
+        shardings = tree_param_shardings(shapes, topo, 0,
+                                         extra_rules=model.sharding_rules)
+        model, params = load_hf_checkpoint(str(tmp_path), model=model,
+                                           dtype=jnp.float32,
+                                           shardings=shardings)
+        wq = params["layers"][0]["attn"]["wq"]
+        assert "model" in str(wq.sharding.spec)  # TP placement applied
+        ids = np.array([[1, 9, 77, 3]], np.int32)
+        got = np.asarray(model.apply(params, jnp.asarray(ids)))
+        want = manual_reference_logits(sd, ids)
+        np.testing.assert_allclose(got[0], want[0], rtol=2e-3, atol=2e-3)
+
+    def test_sharded_placement_on_load(self, tmp_path):
+        """TP/fsdp-aware placement: leaves land on rule-derived shardings as
+        they stream in (reference: sharded meta-load of module_inject)."""
+        from deepspeedsyclsupport_tpu.runtime.zero import tree_param_shardings
+
+        fabricate_hf_checkpoint(str(tmp_path))
+        topo = build_topology(dp=2, fsdp=2, tp=2)
+        model, params = load_hf_checkpoint(str(tmp_path), dtype=jnp.float32)
+        shardings = tree_param_shardings(params, topo, 3,
+                                         extra_rules=model.sharding_rules)
+        model2, params2 = load_hf_checkpoint(str(tmp_path),
+                                             dtype=jnp.float32,
+                                             shardings=shardings)
+        wq = params2["layers"]["attn"]["wq"]
+        assert "model" in str(wq.sharding.spec)
+        np.testing.assert_array_equal(np.asarray(wq),
+                                      np.asarray(params["layers"]["attn"]["wq"]))
+
+
+class TestEnginesServeRealWeights:
+    """VERDICT round-1 criterion: fabricated HF checkpoint on disk → loaded →
+    v1 and v2 engines produce greedy tokens identical to a direct jnp forward
+    with those weights."""
+
+    @pytest.fixture(scope="class")
+    def loaded(self, tmp_path_factory):
+        path = str(tmp_path_factory.mktemp("hfckpt"))
+        fabricate_hf_checkpoint(path)
+        model, params = load_hf_checkpoint(path, dtype=jnp.float32)
+        model.config.dtype = "float32"
+        return model, params
+
+    def _naive_greedy(self, model, params, prompt, n):
+        seq = list(prompt)
+        out = []
+        for _ in range(n):
+            logits = model.apply(params, jnp.asarray([seq], jnp.int32))
+            nxt = int(jnp.argmax(logits[0, -1]))
+            out.append(nxt)
+            seq.append(nxt)
+        return out
+
+    def test_v1_greedy_parity(self, loaded):
+        from deepspeedsyclsupport_tpu.inference import init_inference
+
+        model, params = loaded
+        build_topology(dp=-1)
+        eng = init_inference(model=model, params=params, dtype="float32",
+                             max_seq_len=64)
+        prompt = [3, 17, 88, 5]
+        got = np.asarray(eng.generate(jnp.asarray([prompt], jnp.int32),
+                                      max_new_tokens=8))[0].tolist()
+        want = self._naive_greedy(model, params, prompt, 8)
+        assert got == want
+
+    def test_v2_greedy_parity(self, loaded):
+        from deepspeedsyclsupport_tpu.inference.v2 import InferenceEngineV2
+
+        model, params = loaded
+        build_topology(dp=-1)
+        eng = InferenceEngineV2(model, params, dtype=jnp.float32,
+                                block_size=8, max_context=64,
+                                max_tokens_per_batch=16, max_sequences=4)
+        prompt = [3, 17, 88, 5]
+        got = eng.generate([prompt], max_new_tokens=8)[0]
+        want = self._naive_greedy(model, params, prompt, 8)
+        assert got == want
+
+    def test_init_inference_from_path(self, tmp_path):
+        """init_inference(model=<hf dir>) — the deepspeed-style entry."""
+        from deepspeedsyclsupport_tpu.inference import init_inference
+
+        fabricate_hf_checkpoint(str(tmp_path))
+        build_topology(dp=-1)
+        eng = init_inference(model=str(tmp_path), dtype="float32",
+                             max_seq_len=64)
+        logits = eng(jnp.asarray([[1, 2, 3]], jnp.int32))
+        assert logits.shape == (1, 3, VOCAB)
