@@ -151,14 +151,45 @@ class Engine:
         self.zero_stage = stage
         self.param_shardings = zero_lib.tree_param_shardings(
             params, self.topology, stage, extra_rules=sharding_rules)
-        self.params = jax.tree_util.tree_map(
-            lambda x, s: jax.device_put(jnp.asarray(x), s), params,
-            self.param_shardings)
-        opt_shapes = jax.eval_shape(tx.init, self.params)
-        self.opt_shardings = zero_lib.tree_optimizer_shardings(
-            opt_shapes, self.params, self.param_shardings, self.topology, stage)
-        self.opt_state = jax.jit(
-            tx.init, out_shardings=self.opt_shardings)(self.params)
+
+        # -------------------------------------------------------- offload
+        # ZeRO-Offload / ZeRO-Infinity (reference: cpu_adam host step
+        # ``csrc/adam/cpu_adam.cpp``, stage3 optimizer-state swap
+        # ``stage3.py:1816``, NVMe prefetch
+        # ``partitioned_param_coordinator.py:503``). When enabled, the
+        # device holds only compute-dtype working params; fp32 master
+        # params + optimizer moments live on the host CPU backend, where the
+        # update step runs as a second jitted program; 'nvme' additionally
+        # round-trips the moments through the async swapper between steps.
+        off_opt = self.config.zero.offload_optimizer
+        off_par = self.config.zero.offload_param
+        self.offload_device = None
+        if off_opt.enabled or off_par.enabled:
+            if jax.process_count() > 1:
+                # grads would need a cross-host gather to reach one host's
+                # optimizer; the multi-controller offload story is per-host
+                # shard swapping, not yet wired
+                raise NotImplementedError(
+                    "offload is single-controller only for now (multi-host "
+                    "runs keep optimizer state on device; use zero stage 1-3 "
+                    "sharding instead)")
+            self.offload_device = ("nvme" if "nvme" in (off_opt.device,
+                                                        off_par.device)
+                                   else "cpu")
+        self._swapper = None
+        if self.offload_device is not None:
+            self._init_offload(params, tx, off_opt, off_par)
+        else:
+            self.master_params = None
+            self.params = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(jnp.asarray(x), s), params,
+                self.param_shardings)
+            opt_shapes = jax.eval_shape(tx.init, self.params)
+            self.opt_shardings = zero_lib.tree_optimizer_shardings(
+                opt_shapes, self.params, self.param_shardings, self.topology,
+                stage)
+            self.opt_state = jax.jit(
+                tx.init, out_shardings=self.opt_shardings)(self.params)
         # Stage >= 2: gradients (and the fp32 grad accumulator the scan carries)
         # live fsdp-sharded — the reference's IPG reduce-scatter bucketing
         # (``stage_1_and_2.py:894,1004``). The layout is exactly the stage-3
@@ -170,13 +201,15 @@ class Engine:
         if stage >= 2 and self.topology.axis_sizes["fsdp"] > 1:
             self.grad_shardings = zero_lib.tree_param_shardings(
                 params, self.topology, 3, extra_rules=sharding_rules)
-        log_dist(zero_lib.describe_memory_plan(self.params, self.topology, stage))
+        log_dist(zero_lib.describe_memory_plan(self.params, self.topology,
+                                               stage, self.offload_device))
 
         # ---------------------------------------------------------- step fns
         self._train_batch_fn = None  # built lazily (needs gas)
         self._grad_fn = None
         self._apply_fn = None
         self._eval_fn = None
+        self._host_apply = None
 
         # ---------------------------------------------------------- bookkeeping
         self.global_steps = 0
@@ -196,6 +229,161 @@ class Engine:
 
         self.flops_profiler = FlopsProfiler(self)
         self.losses = None
+
+    # ================================================================ offload
+    def _init_offload(self, params, tx, off_opt, off_par):
+        """Host-resident fp32 master + moments; compute-dtype device params."""
+        cpu = jax.local_devices(backend="cpu")[0]
+        self._cpu_device = cpu
+
+        def to_master(x):
+            x = np.asarray(jax.device_get(x))
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                x = x.astype(np.float32)
+            return jax.device_put(x, cpu)
+
+        self.master_params = jax.tree_util.tree_map(to_master, params)
+        self.params = self._push_params_to_device(params)
+        # master is cpu-committed, so jit compiles this for the host backend
+        self.opt_state = jax.jit(tx.init)(self.master_params)
+        self.opt_shardings = jax.tree_util.tree_map(
+            lambda _: cpu, self.opt_state)
+        if self.offload_device == "nvme":
+            from .swap_tensor import AsyncTensorSwapper
+
+            nvme_path = (off_opt.nvme_path or off_par.nvme_path
+                         or os.path.join(os.getcwd(), "dstpu_nvme_swap"))
+            self._swapper = AsyncTensorSwapper(os.path.join(
+                nvme_path, f"rank{jax.process_index()}"))
+            self._swap_out_opt_state()
+        log_dist(f"offload: master+optimizer on "
+                 f"{'NVMe(' + self._swapper.swap_dir + ')' if self._swapper else 'host CPU'}, "
+                 f"device params dtype={jnp.dtype(self.compute_dtype).name}")
+
+    def _push_params_to_device(self, master_tree):
+        """Compute-dtype device working copies from the fp32 host master.
+        device_put straight from numpy: staging through jnp.asarray would
+        transiently commit each full leaf to the default device."""
+        dtype = self.compute_dtype
+
+        def push(x, s):
+            x = np.asarray(jax.device_get(x))
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                x = x.astype(dtype)
+            return jax.device_put(x, s)
+
+        return jax.tree_util.tree_map(push, master_tree, self.param_shardings)
+
+    def _swap_out_opt_state(self):
+        """Moments → NVMe; drop the host copies (keeps shapes/treedef only)."""
+        from ..checkpoint.engine import _leaf_paths
+
+        self._opt_treedef = jax.tree_util.tree_structure(self.opt_state)
+        leaves = jax.tree_util.tree_leaves(self.opt_state)
+        self._opt_example = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x),
+                                           np.asarray(x).dtype),
+            self.opt_state)
+        names = _leaf_paths(self._opt_example)
+        self._opt_names = names
+        for name, leaf in zip(names, leaves):
+            self._swapper.swap_out("opt/" + name, leaf)
+        self.opt_state = None  # host memory released; state lives on disk
+
+    def _prefetch_opt_state(self):
+        for name in self._opt_names:
+            self._swapper.prefetch("opt/" + name)
+
+    def _swap_in_opt_state(self):
+        leaves = [jax.device_put(self._swapper.retrieve("opt/" + n),
+                                 self._cpu_device)
+                  for n in self._opt_names]
+        self.opt_state = jax.tree_util.tree_unflatten(self._opt_treedef,
+                                                      leaves)
+
+    def _build_grads_batch_fn(self):
+        """Device half of the offloaded step: scan microbatches → grads."""
+        gas = self.config.gradient_accumulation_steps
+
+        def grads_fn(params, scaler, batch, rng):
+            def micro(carry, mb):
+                acc, i = carry
+                loss, metrics, grads = self._micro_grads(
+                    params, mb, jax.random.fold_in(rng, i), scaler)
+                acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+                return (acc, i + 1), (loss, metrics)
+
+            if gas == 1:
+                loss, metrics, grads = self._micro_grads(params, batch, rng,
+                                                         scaler)
+                return grads, loss[None], metrics
+            if self.grad_shardings is not None:
+                # same 1/N accumulator layout as the fused path — this is the
+                # device memory offload exists to save
+                zero_grads = jax.tree_util.tree_map(
+                    lambda p, s: jax.lax.with_sharding_constraint(
+                        jnp.zeros(p.shape, jnp.float32), s),
+                    params, self.grad_shardings)
+            else:
+                zero_grads = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            (grads, _), (losses, metrics) = jax.lax.scan(
+                micro, (zero_grads, 0), batch)
+            grads = jax.tree_util.tree_map(lambda g: g / gas, grads)
+            metrics = jax.tree_util.tree_map(lambda m: m.mean(axis=0), metrics)
+            return grads, losses, metrics
+
+        return jax.jit(grads_fn)
+
+    def _build_host_apply_fn(self):
+        """Host half (the cpu_adam analog): fp32 master update on the CPU
+        backend, returns the new master tree + scalar step metrics."""
+
+        def apply_fn(master, opt_state, scaler, grads):
+            new_master, new_opt, new_scaler, finite, grad_norm = \
+                self._apply_grads(master, opt_state, scaler, grads)
+            return new_master, new_opt, new_scaler, {
+                "grad_norm": grad_norm, "finite": finite,
+                "loss_scale": new_scaler.scale}
+
+        # all inputs are cpu-committed → compiles for the host backend
+        return jax.jit(apply_fn, donate_argnums=(0, 1))
+
+    def _offload_train_batch(self, batch, rng):
+        if self._train_batch_fn is None:
+            self._train_batch_fn = self._build_grads_batch_fn()
+        if self._swapper is not None:
+            self._prefetch_opt_state()  # overlap disk read with device grads
+        # scaler lives host-side between steps (the update runs there);
+        # replicate it onto the mesh for the device half
+        dev_scaler = jax.device_put(self.scaler_state,
+                                    self.topology.replicated())
+        grads, losses, metrics = self._train_batch_fn(
+            self.params, dev_scaler, batch, rng)
+        m2 = self._host_step(grads)
+        out = dict(metrics)
+        out.update({k: m2[k] for k in ("grad_norm", "finite", "loss_scale")})
+        out["loss"] = losses.mean()
+        return out
+
+    def _host_step(self, grads):
+        """Shared tail of an offloaded step: grads → host, (swap in,) fp32
+        master update on CPU, (swap out,) push compute-dtype params back."""
+        if self._host_apply is None:
+            self._host_apply = self._build_host_apply_fn()
+        host_grads = jax.tree_util.tree_map(
+            lambda g: jax.device_put(np.asarray(jax.device_get(g)),
+                                     self._cpu_device), grads)
+        if self._swapper is not None and self.opt_state is None:
+            self._swap_in_opt_state()
+        scaler = jax.device_put(self.scaler_state, self._cpu_device)
+        self.master_params, self.opt_state, self.scaler_state, m2 = \
+            self._host_apply(self.master_params, self.opt_state,
+                             scaler, host_grads)
+        if self._swapper is not None:
+            self._swap_out_opt_state()
+        self.params = self._push_params_to_device(self.master_params)
+        return m2
 
     # ================================================================ loss core
     def _cast_params(self, params):
@@ -223,6 +411,12 @@ class Engine:
 
         (_, (loss, metrics)), grads = jax.value_and_grad(
             scaled_loss, has_aux=True)(params)
+        # fp32 grads regardless of param dtype (under offload the device
+        # params are compute-dtype; the master update must not consume
+        # precision-truncated grads)
+        grads = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32)
+            if jnp.issubdtype(g.dtype, jnp.floating) else g, grads)
         if self.grad_shardings is not None:
             grads = jax.lax.with_sharding_constraint(grads, self.grad_shardings)
         return loss, metrics, grads
@@ -300,7 +494,7 @@ class Engine:
         ``(gas, step_batch, ...)`` and scans). The analog of the reference loop
         forward→backward→step and of ``PipelineEngine.train_batch``
         (``pipe/engine.py:321``)."""
-        if self._train_batch_fn is None:
+        if self._train_batch_fn is None and self.offload_device is None:
             self._train_batch_fn = self._build_train_batch_fn()
         gas = self.config.gradient_accumulation_steps
         if gas > 1:
@@ -308,12 +502,15 @@ class Engine:
                 lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]), batch)
         self.tput_timer.start()
         rng = jax.random.fold_in(self._rng, self.global_steps)
-        self.params, self.opt_state, self.scaler_state, metrics = \
-            self._train_batch_fn(self.params, self.opt_state, self.scaler_state,
-                                 batch, rng)
+        if self.offload_device is not None:
+            metrics = self._offload_train_batch(batch, rng)
+        else:
+            self.params, self.opt_state, self.scaler_state, metrics = \
+                self._train_batch_fn(self.params, self.opt_state,
+                                     self.scaler_state, batch, rng)
         self.global_steps += 1
         self.micro_steps += gas
-        if self.config.flops_profiler.enabled:
+        if self.config.flops_profiler.enabled and self.offload_device is None:
             # post-donation the old state is gone; new state has identical
             # shapes, which is all static FLOP analysis needs
             self.flops_profiler.maybe_profile(
@@ -349,9 +546,12 @@ class Engine:
         if batch is None:
             raise RuntimeError("backward() needs forward() first or an explicit batch")
         self.timers(BACKWARD_GLOBAL_TIMER).start()
-        rng = jax.random.fold_in(self._rng, self.micro_steps)
-        loss_val, _, grads = self._grad_fn(self.params, batch, rng,
-                                           self.scaler_state)
+        repl = self.topology.replicated()
+        rng = jax.device_put(jax.random.fold_in(self._rng, self.micro_steps),
+                             repl)
+        # under offload the scaler lives host-side between steps
+        scaler = jax.device_put(self.scaler_state, repl)
+        loss_val, _, grads = self._grad_fn(self.params, batch, rng, scaler)
         if self._accum_grads is None:
             self._accum_grads = grads
         else:
@@ -372,6 +572,19 @@ class Engine:
         ``_take_model_step:2054``)."""
         if self._accum_grads is None:
             raise RuntimeError("step() before backward()")
+        if self.offload_device is not None:
+            self.timers(STEP_GLOBAL_TIMER).start()
+            grads = jax.tree_util.tree_map(
+                lambda g: g / float(self._accum_count), self._accum_grads)
+            metrics = dict(self._host_step(grads))
+            self.timers(STEP_GLOBAL_TIMER).stop()
+            if self._accum_losses:
+                metrics["loss"] = jnp.stack(self._accum_losses).mean()
+            self._accum_grads, self._accum_count = None, 0
+            self._accum_losses = []
+            self.global_steps += 1
+            self._post_step(metrics)
+            return metrics
         if self._apply_fn is None:
             def apply_fn(params, opt_state, scaler, grads, count):
                 grads = jax.tree_util.tree_map(lambda g: g / count, grads)
@@ -490,13 +703,22 @@ class Engine:
         tag = tag or f"global_step{self.global_steps}"
         self._validate_tag(tag)
         path = os.path.join(save_dir, tag)
-        state = {"params": self.params, "opt_state": self.opt_state,
-                 "scaler": self.scaler_state}
+        if self.offload_device is not None:
+            # persist the fp32 master copy (device params are lossy bf16)
+            if self._swapper is not None and self.opt_state is None:
+                self._swap_in_opt_state()
+            state = {"params": self.master_params, "opt_state": self.opt_state,
+                     "scaler": self.scaler_state}
+        else:
+            state = {"params": self.params, "opt_state": self.opt_state,
+                     "scaler": self.scaler_state}
         meta = {"global_steps": self.global_steps, "micro_steps": self.micro_steps,
                 "skipped_steps": self.skipped_steps,
                 "config": {"zero_stage": self.zero_stage},
                 "client_state": client_state or {}}
         save_tree(path, state, meta)
+        if self._swapper is not None:
+            self._swap_out_opt_state()
         if save_latest and jax.process_index() == 0:
             with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
                 f.write(tag)
@@ -522,14 +744,34 @@ class Engine:
         path = os.path.join(load_dir, tag)
         repl = self.topology.replicated()
         scaler_sh = jax.tree_util.tree_map(lambda _: repl, self.scaler_state)
-        template = {"params": (self.params, self.param_shardings),
-                    "opt_state": (self.opt_state, self.opt_shardings),
-                    "scaler": (self.scaler_state, scaler_sh)}
-        state, meta = load_tree(path, template)
-        self.params = state["params"]
-        if load_optimizer_states:
-            self.opt_state = state["opt_state"]
-            self.scaler_state = state["scaler"]
+        if self.offload_device is not None:
+            if self._swapper is not None and self.opt_state is None:
+                self._swap_in_opt_state()  # template needs the live tree
+            cpu = self._cpu_device
+            template = {"params": (self.master_params,
+                                   jax.tree_util.tree_map(lambda _: cpu,
+                                                          self.master_params)),
+                        "opt_state": (self.opt_state,
+                                      jax.tree_util.tree_map(lambda _: cpu,
+                                                             self.opt_state)),
+                        "scaler": (self.scaler_state, scaler_sh)}
+            state, meta = load_tree(path, template)
+            self.master_params = state["params"]
+            if load_optimizer_states:
+                self.opt_state = state["opt_state"]
+                self.scaler_state = state["scaler"]
+            self.params = self._push_params_to_device(self.master_params)
+            if self._swapper is not None:
+                self._swap_out_opt_state()
+        else:
+            template = {"params": (self.params, self.param_shardings),
+                        "opt_state": (self.opt_state, self.opt_shardings),
+                        "scaler": (self.scaler_state, scaler_sh)}
+            state, meta = load_tree(path, template)
+            self.params = state["params"]
+            if load_optimizer_states:
+                self.opt_state = state["opt_state"]
+                self.scaler_state = state["scaler"]
         self.global_steps = meta.get("global_steps", 0)
         self.micro_steps = meta.get("micro_steps", 0)
         # skipped_steps rides in scaler_state.overflows, restored above

@@ -175,7 +175,8 @@ def tree_optimizer_shardings(opt_state, params, param_shardings, topo: MeshTopol
     return jax.tree_util.tree_map_with_path(rule, opt_state)
 
 
-def describe_memory_plan(params, topo: MeshTopology, stage: int) -> str:
+def describe_memory_plan(params, topo: MeshTopology, stage: int,
+                         offload_device: Optional[str] = None) -> str:
     """Human-readable partition report (reference: ``see_memory_usage`` +
     stage3 partition logging)."""
     n_params = sum(math.prod(np.shape(p)) for p in jax.tree_util.tree_leaves(params))
@@ -183,6 +184,13 @@ def describe_memory_plan(params, topo: MeshTopology, stage: int) -> str:
     param_factor = n if stage >= 3 and n > 1 else 1
     grad_factor = n if stage >= 2 and n > 1 else 1
     opt_factor = n if stage >= 1 and n > 1 else 1
-    return (f"ZeRO stage {stage}: {n_params / 1e6:.1f}M params, fsdp={n}; "
-            f"param mem 1/{param_factor}, grad mem 1/{grad_factor}, "
-            f"optimizer mem 1/{opt_factor} per device")
+    msg = (f"ZeRO stage {stage}: {n_params / 1e6:.1f}M params, fsdp={n}; "
+           f"param mem 1/{param_factor}, grad mem 1/{grad_factor}, "
+           f"optimizer mem 1/{opt_factor} per device")
+    if offload_device == "cpu":
+        msg += ("; offload: fp32 master + optimizer state on host CPU, "
+                "device holds compute-dtype params only")
+    elif offload_device == "nvme":
+        msg += ("; offload: fp32 master on host, optimizer state swapped to "
+                "NVMe between steps, device holds compute-dtype params only")
+    return msg
