@@ -30,6 +30,17 @@ from .kv_cache import BlockedKV
 from ...models.layers import apply_rope, glu_mlp, rms_norm
 
 
+def _mlp(p, y, cfg):
+    """Per-layer MLP over flat tokens [T, D]: dense GLU, or exact top-k MoE
+    via grouped GEMMs (the moe_scatter/cutlass-multi-GEMM/moe_gather analog,
+    ``parallel/moe.moe_mlp_nodrop``)."""
+    if cfg.any_moe:
+        from ...parallel.moe import moe_mlp_nodrop
+
+        return moe_mlp_nodrop(p["moe"], y, cfg)
+    return glu_mlp(p["mlp"], y[None], cfg)[0]
+
+
 def _paged_attention(q, k_cache, v_cache, token_seq, token_pos, block_tables,
                      block_size: int):
     """q: [T, H, D]; caches: [num_slots, KVH, D] (flat slot axis);
@@ -79,9 +90,6 @@ def ragged_forward(model, params: Any, kv: BlockedKV, tokens, token_seq,
     """
     cfg = model.config
     assert cfg.scan_layers, "ragged engine requires scan_layers param layout"
-    assert not cfg.any_moe, (
-        "MoE ragged serving not yet wired (use the v1 engine); reference "
-        "moe_scatter/moe_gather analog tracked in SURVEY.md §7 phase 7")
     bs = block_size
     num_slots = kv.num_slots
     t = tokens.shape[0]
@@ -115,7 +123,7 @@ def ragged_forward(model, params: Any, kv: BlockedKV, tokens, token_seq,
         x = (x + jnp.einsum("tq,qd->td", attn.reshape(t, cfg.q_dim),
                             p["attn"]["wo"])).astype(x.dtype)
         y2 = rms_norm(x, p["mlp_norm"]["scale"], cfg.rms_norm_eps)
-        h = glu_mlp(p["mlp"], y2[None], cfg)[0]
+        h = _mlp(p, y2, cfg)
         return (x + h).astype(x.dtype), (k_cache, v_cache)
 
     x, (nk, nv) = jax.lax.scan(layer, x, (params["layers"], kv.k, kv.v))
@@ -182,7 +190,7 @@ def decode_forward(model, params: Any, kv: BlockedKV, tokens, positions,
         x2 = (x + jnp.einsum("sq,qd->sd", attn.reshape(s, cfg.q_dim),
                              p["attn"]["wo"])).astype(x.dtype)
         y2 = rms_norm(x2, p["mlp_norm"]["scale"], cfg.rms_norm_eps)
-        h = glu_mlp(p["mlp"], y2[None], cfg)[0]
+        h = _mlp(p, y2, cfg)
         return (x2 + h).astype(x.dtype), (k_cache, v_cache)
 
     x, (nk, nv) = jax.lax.scan(layer, x, (params["layers"], kv.k, kv.v))

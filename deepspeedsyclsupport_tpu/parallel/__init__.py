@@ -9,7 +9,7 @@ TPU-native analogs of the reference's strategy layer (SURVEY.md §2.4):
 * :mod:`.pipeline` — pipeline parallel 1F1B (``deepspeed/runtime/pipe/``)
 * :mod:`.tensor_parallel` — TP sharding-rule helpers (``module_inject/auto_tp.py``)
 """
-from .moe import moe_mlp, topk_gating  # noqa: F401
+from .moe import moe_mlp, moe_mlp_nodrop, topk_gating  # noqa: F401
 from .pipeline import (InferenceSchedule, PipelineModule,  # noqa: F401
                        TrainSchedule, partition_balanced, partition_uniform,
                        spmd_pipeline)

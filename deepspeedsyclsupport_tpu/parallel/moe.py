@@ -109,3 +109,45 @@ def moe_mlp(p: Dict[str, Any], x: jnp.ndarray, cfg,
 
     out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
     return out.reshape(b, s, d), aux.astype(jnp.float32)
+
+
+def moe_mlp_nodrop(p: Dict[str, Any], x: jnp.ndarray, cfg) -> jnp.ndarray:
+    """Exact top-k MoE for flat token streams (the serving path).
+
+    The reference serves MoE through ``moe_scatter`` → CUTLASS grouped GEMM →
+    ``moe_gather`` (``inference/v2/kernels/ragged_ops/``,
+    ``modules/implementations/moe/cutlass_multi_gemm.py``). TPU-native
+    equivalent: sort (token, choice) rows by expert and run the three expert
+    GEMMs as ``jax.lax.ragged_dot`` grouped matmuls. No capacity truncation —
+    inference must never drop a routed token (unlike the training path's
+    capacity buffers, :func:`moe_mlp`).
+
+    x: [T, D] flat tokens → [T, D].
+    """
+    t, d = x.shape
+    e, k = cfg.num_experts, cfg.num_experts_per_tok
+    logits = jnp.einsum("td,de->te", x.astype(jnp.float32),
+                        p["router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_w, expert_idx = jax.lax.top_k(probs, k)              # [T, k]
+    gate_w = gate_w / jnp.maximum(gate_w.sum(-1, keepdims=True), 1e-9)
+
+    flat_expert = expert_idx.reshape(t * k)
+    flat_tok = jnp.repeat(jnp.arange(t), k)
+    order = jnp.argsort(flat_expert, stable=True)             # moe_scatter
+    sorted_tok = flat_tok[order]
+    xs = x[sorted_tok]                                        # [T*k, D]
+    group_sizes = jnp.bincount(flat_expert, length=e).astype(jnp.int32)
+
+    act = jax.nn.silu if cfg.activation == "silu" else jax.nn.gelu
+    wg = p["w_gate"].astype(x.dtype)
+    wu = p["w_up"].astype(x.dtype)
+    wd = p["w_down"].astype(x.dtype)
+    gate = jax.lax.ragged_dot(xs, wg, group_sizes)
+    up = jax.lax.ragged_dot(xs, wu, group_sizes)
+    ys = jax.lax.ragged_dot(act(gate) * up, wd, group_sizes)  # [T*k, D]
+
+    w_flat = gate_w.reshape(t * k)[order].astype(x.dtype)
+    out = jnp.zeros((t, d), x.dtype).at[sorted_tok].add(      # moe_gather
+        ys * w_flat[:, None])
+    return out

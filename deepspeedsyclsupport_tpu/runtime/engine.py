@@ -135,13 +135,39 @@ class Engine:
             dynamic=fp16.enabled and fp16.dynamic,
             hysteresis=fp16.hysteresis)
 
+        # ---------------------------------------------------------- zero++
+        zc = self.config.zero
+        self._zeropp_enabled = (zc.zero_quantized_weights
+                                or zc.zero_quantized_gradients
+                                or zc.zero_hpz_partition_size > 1)
+        if self._zeropp_enabled:
+            axes = self.topology.axis_sizes
+            n = axes["fsdp"]
+            bad = [a for a in ("model", "pipe", "seq", "expert")
+                   if axes[a] > 1]
+            if zc.stage != 3 or n <= 1 or bad:
+                raise ValueError(
+                    f"ZeRO++ flags need stage 3 on a pure data/fsdp mesh "
+                    f"with fsdp>1 (stage={zc.stage}, fsdp={n}, "
+                    f"other axes in use: {bad})")
+            h = zc.zero_hpz_partition_size
+            if h > 1 and n % h:
+                raise ValueError(
+                    f"zero_hpz_partition_size {h} must divide fsdp {n}")
+            if zc.offload_optimizer.enabled or zc.offload_param.enabled:
+                raise ValueError("ZeRO++ explicit path and offload are "
+                                 "mutually exclusive for now")
+
         # ---------------------------------------------------------- optimizer
         sched_cfg = self.config.scheduler
         self.lr_schedule = lr_schedule or build_schedule(
             sched_cfg.type, sched_cfg.params, self.config.optimizer.lr)
         tx = build_optimizer(self.config.optimizer.type, self.config.optimizer.params,
                              self.lr_schedule)
-        if self.config.gradient_clipping and self.config.gradient_clipping > 0:
+        if (self.config.gradient_clipping and self.config.gradient_clipping > 0
+                and not self._zeropp_enabled):
+            # zero++ clips manually inside shard_map: optax's global-norm
+            # transform would compute a per-shard norm there
             tx = optax.chain(
                 optax.clip_by_global_norm(self.config.gradient_clipping), tx)
         self.optimizer = tx
@@ -428,7 +454,22 @@ class Engine:
         grads = unscale_grads(grads, scaler)
         finite = grads_finite(grads) if self.fp16_enabled else jnp.asarray(True)
         grad_norm = optax.global_norm(grads)
+        clip = self.config.gradient_clipping
+        if self._zeropp_enabled and clip and clip > 0:
+            # zero++ removes optax's global-norm transform from the chain
+            # (it would mis-compute inside shard_map); on this pjit/eager
+            # path clip manually so the configured clipping still applies
+            scale_f = jnp.minimum(1.0, clip / jnp.maximum(grad_norm, 1e-6))
+            grads = jax.tree_util.tree_map(lambda g: g * scale_f, grads)
 
+        new_params, new_opt, new_scaler = self._finish_update(
+            params, opt_state, scaler, grads, finite)
+        return new_params, new_opt, new_scaler, finite, grad_norm
+
+    def _finish_update(self, params, opt_state, scaler, grads, finite):
+        """Shared post-norm tail: optimizer update, overflow-skip revert,
+        loss-scale bookkeeping. Used by the pjit/eager paths and the ZeRO++
+        shard_map body — fp16 skip semantics live in exactly one place."""
         updates, new_opt = self.optimizer.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
 
@@ -444,10 +485,19 @@ class Engine:
             scaler, finite, dynamic=self.fp16_enabled and fp16.dynamic,
             scale_window=fp16.loss_scale_window, min_scale=fp16.min_loss_scale,
             hysteresis=fp16.hysteresis)
-        return new_params, new_opt, new_scaler, finite, grad_norm
+        return new_params, new_opt, new_scaler
 
     # ================================================================ fused path
     def _build_train_batch_fn(self):
+        if self._zeropp_enabled:
+            from .zeropp import build_zeropp_train_fn
+
+            self._train_batch_raw = None  # explicit shard_map path
+            if self.config.flops_profiler.enabled:
+                logger.warning(
+                    "flops_profiler is not available on the ZeRO++ explicit "
+                    "shard_map path; profiling is disabled for this run")
+            return build_zeropp_train_fn(self)
         gas = self.config.gradient_accumulation_steps
 
         def train_batch_fn(params, opt_state, scaler, batch, rng):
@@ -510,7 +560,8 @@ class Engine:
                                      self.scaler_state, batch, rng)
         self.global_steps += 1
         self.micro_steps += gas
-        if self.config.flops_profiler.enabled and self.offload_device is None:
+        if (self.config.flops_profiler.enabled and self.offload_device is None
+                and getattr(self, "_train_batch_raw", None) is not None):
             # post-donation the old state is gone; new state has identical
             # shapes, which is all static FLOP analysis needs
             self.flops_profiler.maybe_profile(

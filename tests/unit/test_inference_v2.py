@@ -169,6 +169,47 @@ class TestEngineV2:
         for p, g in zip(prompts, got):
             assert g == _naive_greedy(model, params, p, 6)
 
+    def test_moe_prefill_logits_match_dense(self):
+        """MoE ragged serving (reference moe_scatter/grouped-GEMM/moe_gather):
+        v2 must serve tiny-moe with logits parity vs the dense forward.
+        capacity_factor is raised so the training-path capacity buffers never
+        truncate — the serving path is exact by construction."""
+        model = build_model("tiny-moe", dtype="float32", capacity_factor=16.0)
+        params = model.init_params()
+        eng = _v2(model, params)
+        prompt = [1, 5, 9, 200, 3]
+        out = eng.put([1], [prompt])
+        dense = model.apply(params, jnp.asarray([prompt], jnp.int32))
+        np.testing.assert_allclose(out[1], np.asarray(dense[0, -1]),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_moe_generate_matches_naive(self):
+        """Greedy decode parity over the MoE ragged + decode fast paths —
+        the TestV1V2Parity shape from the round-1 verdict."""
+        model = build_model("tiny-moe", dtype="float32", capacity_factor=16.0)
+        params = model.init_params()
+        eng = _v2(model, params)
+        prompts = [[7, 3, 11], [4, 100, 42, 8, 19]]
+        got = eng.generate(prompts, max_new_tokens=6)
+        for p, g in zip(prompts, got):
+            assert g == _naive_greedy(model, params, p, 6)
+
+    def test_moe_nodrop_matches_capacity_path(self):
+        """Unit parity: grouped-GEMM no-drop MoE == capacity-einsum MoE when
+        capacity never truncates."""
+        from deepspeedsyclsupport_tpu.models import get_config
+        from deepspeedsyclsupport_tpu.parallel import moe_mlp, moe_mlp_nodrop
+
+        cfg = get_config("tiny-moe", capacity_factor=16.0)
+        model = build_model(cfg)
+        p = model.init_params()["layers"]["moe"]
+        p0 = jax.tree_util.tree_map(lambda x: x[0], p)  # layer 0 weights
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 24, cfg.hidden_size))
+        want, _ = moe_mlp(p0, x, cfg)
+        got = moe_mlp_nodrop(p0, x[0], cfg)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want[0]),
+                                   rtol=2e-4, atol=2e-4)
+
     def test_continuous_batching_oversubscribed(self, tiny):
         """More prompts than max_sequences: engine must admit in waves and
         still produce exact per-prompt results."""
