@@ -155,12 +155,38 @@ def _load_native(path: str, example, shardings):
 
 
 # ---------------------------------------------------------------- orbax backend
-def _save_orbax(path: str, state) -> None:  # pragma: no cover - needs real pod
+def _globalize(state):
+    """Host-local leaves (uncommitted scalars like loss-scale state, or numpy)
+    → fully-replicated global arrays. Orbax refuses host-local jax.Arrays in a
+    multi-controller save; every process holds the same value for these, so
+    declaring them replicated over the world mesh is exact."""
+    from jax.experimental import multihost_utils
+
+    from ..comm.topology import get_world_topology
+
+    mesh = get_world_topology().mesh
+    if jax.process_count() == 1:
+        return state  # single-controller saves take the native backend anyway
+
+    def fix(x):
+        if not hasattr(x, "dtype"):
+            return x
+        sh = getattr(x, "sharding", None)
+        if sh is not None and len(sh.device_set) > 1:
+            return x  # already a global (mesh-sharded/replicated) array
+        return multihost_utils.host_local_array_to_global_array(
+            np.asarray(x), mesh, jax.sharding.PartitionSpec())
+
+    return jax.tree_util.tree_map(fix, state)
+
+
+def _save_orbax(path: str, state) -> None:
     import orbax.checkpoint as ocp
 
     ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
     try:
-        ckptr.save(os.path.join(path, STATE_DIR), state, force=True)
+        ckptr.save(os.path.join(path, STATE_DIR), _globalize(state),
+                   force=True)
     finally:
         ckptr.close()
 

@@ -255,7 +255,11 @@ def get_device_count() -> int:
 
 
 def get_local_rank() -> int:
-    return 0
+    """Rank within this host (reference: LOCAL_RANK env set per-process by
+    ``launcher/launch.py``). JAX is one process per host, so this is the
+    launcher-provided LOCAL_RANK when present, else 0."""
+    v = os.environ.get("LOCAL_RANK")
+    return int(v) if v is not None else 0
 
 
 def barrier():

@@ -44,6 +44,11 @@ class ModelConfig:
     scan_layers: bool = True        # lax.scan over stacked layer params
     dropout: float = 0.0
     dtype: str = "bfloat16"         # compute dtype hint (engine may override)
+    # Random layerwise token dropping (reference csrc/random_ltd/ +
+    # data_pipeline/data_routing): middle layers process only
+    # random_ltd_current randomly kept tokens (engine schedules the value)
+    random_ltd: bool = False
+    random_ltd_current: Optional[int] = None
 
     # Initializer
     initializer_range: float = 0.02

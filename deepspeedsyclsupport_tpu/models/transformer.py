@@ -128,7 +128,8 @@ class CausalLM:
                  segment_ids: Optional[jnp.ndarray] = None,
                  cache: Optional[KVCache] = None,
                  rng: Optional[jax.Array] = None,
-                 kv_mask: Optional[jnp.ndarray] = None
+                 kv_mask: Optional[jnp.ndarray] = None,
+                 train: bool = True
                  ) -> Tuple[jnp.ndarray, Optional[KVCache], jnp.ndarray]:
         """Returns (logits [B,S,V] fp32, new_cache, total_aux_loss)."""
         cfg = self.config
@@ -158,7 +159,52 @@ class CausalLM:
             layer_fn = jax.checkpoint(layer_fn)
 
         new_cache = None
-        if cfg.scan_layers:
+        rltd_keep = cfg.random_ltd_current
+        use_rltd = (cfg.random_ltd and train and cache is None
+                    and cfg.scan_layers and rltd_keep is not None
+                    and rltd_keep < s and cfg.num_layers >= 3)
+        if use_rltd:
+            # Random layerwise token dropping (reference csrc/random_ltd/
+            # token_sort/gather_scatter kernels + data_routing/basic_layer):
+            # first and last layers see every token; the middle stack runs on
+            # a random per-row subset of rltd_keep tokens (kept in causal
+            # order), and dropped tokens skip those layers via the residual.
+            lp = params["layers"]
+            first = jax.tree_util.tree_map(lambda t: t[0], lp)
+            mid = jax.tree_util.tree_map(lambda t: t[1:-1], lp)
+            last = jax.tree_util.tree_map(lambda t: t[-1], lp)
+            rngs = jax.random.split(rng, cfg.num_layers + 1)
+            x, _, aux0 = self._layer(first, x, positions, segment_ids, None,
+                                     rngs[0])
+
+            def sample_idx(r):
+                return jnp.sort(jax.random.permutation(r, s)[:rltd_keep])
+
+            idx = jax.vmap(sample_idx)(jax.random.split(rngs[-1], b))
+            x_sub = jnp.take_along_axis(x, idx[..., None], axis=1)
+            pos_sub = jnp.take_along_axis(positions, idx, axis=1)
+            seg_sub = (jnp.take_along_axis(segment_ids, idx, axis=1)
+                       if segment_ids is not None else None)
+
+            def mid_fn(xc, p, rng_l):
+                xc, _, aux = self._layer(p, xc, pos_sub, seg_sub, None, rng_l)
+                return xc, aux
+
+            if cfg.remat:
+                mid_fn = jax.checkpoint(mid_fn)
+
+            def mid_body(xc, inp):
+                p, rng_l = inp
+                xc, aux = mid_fn(xc, p, rng_l)
+                return xc, aux
+
+            x_sub, auxes = jax.lax.scan(
+                mid_body, x_sub, (mid, rngs[1:cfg.num_layers - 1]))
+            x = x.at[jnp.arange(b)[:, None], idx].set(x_sub.astype(x.dtype))
+            x, _, auxl = self._layer(last, x, positions, segment_ids, None,
+                                     rngs[cfg.num_layers - 1])
+            aux_total = aux0 + auxes.sum() + auxl
+        elif cfg.scan_layers:
             dummy = jnp.zeros((cfg.num_layers, 0)) if cache is None else None
             ks = jax.random.split(rng, cfg.num_layers)
 
@@ -205,14 +251,15 @@ class CausalLM:
 
     # ------------------------------------------------------------------ loss
     def loss(self, params: Params, batch: Dict[str, jnp.ndarray],
-             rng: Optional[jax.Array] = None):
+             rng: Optional[jax.Array] = None, train: bool = True):
         """Next-token cross-entropy with optional ``labels``/``loss_mask``;
-        the engine's ``loss_fn`` protocol."""
+        the engine's ``loss_fn`` protocol. ``train=False`` disables
+        train-only stochastic behavior (random-LTD token dropping)."""
         input_ids = batch["input_ids"]
         logits, _, aux = self._forward(
             params, input_ids,
             positions=batch.get("positions"),
-            segment_ids=batch.get("segment_ids"), rng=rng)
+            segment_ids=batch.get("segment_ids"), rng=rng, train=train)
         if "labels" in batch:
             labels = batch["labels"]
             mask = batch.get("loss_mask", (labels >= 0).astype(jnp.float32))

@@ -312,6 +312,51 @@ class CheckpointConfig:
 
 
 @dataclass
+class DataEfficiencyConfig:
+    """``data_efficiency`` section (reference:
+    ``runtime/data_pipeline/config.py`` + ``constants.py`` key families),
+    plus the legacy top-level ``curriculum_learning`` section. Resolved
+    curriculum/random-ltd dicts feed ``runtime/data_pipeline``."""
+    enabled: bool = False
+    seed: int = 1234
+    curriculum: Optional[Dict[str, Any]] = None      # scheduler config dict
+    curriculum_metric: str = "seqlen"
+    random_ltd: Optional[Dict[str, Any]] = None      # scheduler config dict
+
+    @classmethod
+    def from_config_dict(cls, d: Dict[str, Any]) -> "DataEfficiencyConfig":
+        de = dict(d.get("data_efficiency", {}))
+        sampling = dict(de.get("data_sampling", {}))
+        routing = dict(de.get("data_routing", {}))
+        curriculum = None
+        metric = "seqlen"
+        # nested (data_efficiency.data_sampling.curriculum_learning) …
+        cl = dict(sampling.get("curriculum_learning", {}))
+        if cl.get("enabled", False):
+            metrics = dict(cl.get("curriculum_metrics", {}))
+            if len(metrics) > 1:
+                raise ValueError(
+                    "multiple curriculum_metrics are not supported; "
+                    f"configure exactly one (got {sorted(metrics)})")
+            if metrics:  # reference: named metric sub-sections
+                metric, cl = next(iter(metrics.items()))
+                cl = dict(cl)
+            curriculum = cl
+        # … or legacy top-level curriculum_learning
+        legacy = dict(d.get("curriculum_learning", {}))
+        if curriculum is None and legacy.get("enabled", False):
+            curriculum = legacy
+            metric = legacy.get("curriculum_type", "seqlen")
+        ltd = dict(routing.get("random_ltd", {}))
+        random_ltd = ltd if ltd.get("enabled", False) else None
+        enabled = bool(de.get("enabled", False) or curriculum is not None
+                       or random_ltd is not None)
+        return cls(enabled=enabled, seed=int(de.get("seed", 1234)),
+                   curriculum=curriculum, curriculum_metric=metric,
+                   random_ltd=random_ltd)
+
+
+@dataclass
 class DSTpuConfig:
     """Top-level typed config (reference: ``DeepSpeedConfig``)."""
 
@@ -330,6 +375,8 @@ class DSTpuConfig:
     comms_logger: CommsLoggerConfig
     flops_profiler: FlopsProfilerConfig
     checkpoint: CheckpointConfig
+    data_efficiency: DataEfficiencyConfig = field(
+        default_factory=DataEfficiencyConfig)
     gradient_clipping: float = C.GRADIENT_CLIPPING_DEFAULT
     prescale_gradients: bool = False
     gradient_predivide_factor: float = 1.0
@@ -377,6 +424,7 @@ class DSTpuConfig:
             comms_logger=CommsLoggerConfig.from_dict(_sub(d, C.COMMS_LOGGER)),
             flops_profiler=FlopsProfilerConfig.from_dict(_sub(d, C.FLOPS_PROFILER)),
             checkpoint=CheckpointConfig.from_dict(_sub(d, C.CHECKPOINT)),
+            data_efficiency=DataEfficiencyConfig.from_config_dict(d),
             gradient_clipping=float(d.get(C.GRADIENT_CLIPPING,
                                           C.GRADIENT_CLIPPING_DEFAULT)),
             prescale_gradients=bool(d.get(C.PRESCALE_GRADIENTS, False)),

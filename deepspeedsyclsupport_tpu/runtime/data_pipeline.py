@@ -1,0 +1,203 @@
+"""Data-efficiency pipeline: curriculum learning, data sampling, random-LTD.
+
+Analog of ``deepspeed/runtime/data_pipeline/`` (2177 LoC):
+
+* ``CurriculumScheduler`` (``curriculum_scheduler.py:11``) — difficulty
+  schedules ``fixed_linear`` / ``fixed_root`` / ``fixed_discrete`` /
+  ``custom``, same config keys (``min_difficulty``, ``max_difficulty``,
+  ``schedule_type``, ``schedule_config{total_curriculum_step,
+  difficulty_step, root_degree | difficulty, max_step}``).
+* ``CurriculumDataSampler`` — the ``data_sampling/data_sampler.py`` analog:
+  difficulty-gated index sampling over per-sample metric values
+  (value- or percentile-based, reference ``CURRICULUM_LEARNING_
+  {VALUE,PERCENTILE}_BASED``), deterministic per-epoch shuffle.
+* ``RandomLTDScheduler`` (``data_routing/scheduler.py``) — scheduled
+  kept-token count for random layerwise token dropping; the token
+  gather/scatter the reference does in ``csrc/random_ltd/`` is jnp
+  ``take_along_axis``/``.at[].set`` inside the model
+  (``models/transformer.py``), which XLA fuses.
+
+TPU note: difficulty changes the *shape* of the compiled program (seqlen or
+kept-token count), so each difficulty level compiles once. The reference
+quantizes levels with ``difficulty_step`` for tensor cores; here the same
+knob bounds the number of XLA compilations.
+"""
+import math
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.logging import logger
+
+# config keys — reference data_pipeline/constants.py
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+CUSTOM = "custom"
+VALUE_BASED = "value"
+PERCENTILE_BASED = "percentile"
+
+
+class CurriculumScheduler:
+    """Difficulty schedule (reference ``curriculum_scheduler.py:11``)."""
+
+    def __init__(self, config: Dict[str, Any]):
+        for key in ("min_difficulty", "max_difficulty", "schedule_type"):
+            if key not in config:
+                raise ValueError(f"curriculum learning requires '{key}'")
+        self.min_difficulty = int(config["min_difficulty"])
+        self.max_difficulty = int(config["max_difficulty"])
+        self.schedule_type = config["schedule_type"]
+        self.schedule = dict(config.get("schedule_config", {}))
+        self.current_difficulty = self.min_difficulty
+        self._custom_fn = config.get("difficulty_fn")
+
+        if self.schedule_type in (FIXED_LINEAR, FIXED_ROOT):
+            for key in ("total_curriculum_step", "difficulty_step"):
+                if key not in self.schedule:
+                    raise ValueError(
+                        f"{self.schedule_type} schedule requires "
+                        f"schedule_config '{key}'")
+            if self.schedule_type == FIXED_ROOT and \
+                    "root_degree" not in self.schedule:
+                raise ValueError("fixed_root requires 'root_degree'")
+        elif self.schedule_type == FIXED_DISCRETE:
+            diff = self.schedule.get("difficulty")
+            steps = self.schedule.get("max_step")
+            if not diff or steps is None or len(diff) != len(steps) + 1:
+                raise ValueError(
+                    "fixed_discrete needs schedule_config 'difficulty' (n) "
+                    "and 'max_step' (n-1)")
+        elif self.schedule_type == CUSTOM:
+            if not callable(self._custom_fn):
+                raise ValueError("custom schedule requires a callable "
+                                 "'difficulty_fn' in the config")
+        else:
+            raise ValueError(f"unknown schedule_type {self.schedule_type!r}")
+
+    def get_difficulty(self, global_steps: int) -> int:
+        if self.schedule_type == FIXED_DISCRETE:
+            for d, until in zip(self.schedule["difficulty"],
+                                self.schedule["max_step"]):
+                if global_steps <= until:
+                    return int(d)
+            return int(self.schedule["difficulty"][-1])
+        if self.schedule_type == CUSTOM:
+            return int(self._custom_fn(global_steps))
+        total = int(self.schedule["total_curriculum_step"])
+        step_q = int(self.schedule["difficulty_step"])
+        frac = min(1.0, max(0.0, global_steps / max(total, 1)))
+        if self.schedule_type == FIXED_ROOT:
+            frac = frac ** (1.0 / float(self.schedule["root_degree"]))
+        raw = self.min_difficulty + frac * (self.max_difficulty -
+                                            self.min_difficulty)
+        d = int(raw // step_q) * step_q
+        return int(min(max(d, self.min_difficulty), self.max_difficulty))
+
+    def update_difficulty(self, global_steps: int) -> int:
+        self.current_difficulty = self.get_difficulty(global_steps)
+        return self.current_difficulty
+
+    # checkpointable state (reference state dict protocol)
+    def state_dict(self) -> Dict[str, Any]:
+        return {"current_difficulty": self.current_difficulty}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.current_difficulty = int(sd["current_difficulty"])
+
+
+def truncate_to_difficulty(batch, difficulty: int):
+    """Seqlen-metric curriculum: clip every [B, S, ...] leaf to S' =
+    ``difficulty`` along dim 1 (reference legacy curriculum truncation used
+    by megatron integration). Shorter-than-difficulty batches pass through."""
+    import jax
+
+    def clip(x):
+        if getattr(x, "ndim", 0) >= 2 and x.shape[1] > difficulty:
+            return x[:, :difficulty]
+        return x
+
+    return jax.tree_util.tree_map(clip, batch)
+
+
+class CurriculumDataSampler:
+    """Difficulty-gated batch sampler (``data_sampling/data_sampler.py``
+    ``DeepSpeedDataSampler`` analog).
+
+    ``metric_values[i]`` scores sample ``i`` (e.g. sequence length); a batch
+    at step ``t`` draws only from samples whose metric is within the
+    scheduler's current difficulty — by value, or by percentile of the
+    metric distribution (reference difficulty_type value/percentile).
+    """
+
+    def __init__(self, metric_values: Sequence[float], batch_size: int,
+                 scheduler: CurriculumScheduler,
+                 difficulty_type: str = VALUE_BASED,
+                 seed: int = 1234, drop_last: bool = True):
+        self.metric = np.asarray(metric_values, np.float64)
+        self.order = np.argsort(self.metric, kind="stable")  # easy → hard
+        self.batch_size = int(batch_size)
+        self.scheduler = scheduler
+        self.difficulty_type = difficulty_type
+        if difficulty_type not in (VALUE_BASED, PERCENTILE_BASED):
+            raise ValueError(f"unknown difficulty_type {difficulty_type!r}")
+        self.seed = seed
+        self.drop_last = drop_last
+        self.global_step = 0
+        self.epoch = 0
+
+    def _eligible(self) -> np.ndarray:
+        d = self.scheduler.update_difficulty(self.global_step)
+        if self.difficulty_type == VALUE_BASED:
+            n = int(np.searchsorted(self.metric[self.order], d, side="right"))
+        else:  # percentile of samples admitted
+            n = int(math.ceil(len(self.metric) * min(d, 100) / 100.0))
+        return self.order[:max(n, self.batch_size if self.drop_last else 1)]
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        rng = np.random.default_rng(self.seed + self.epoch)
+        n_batches = len(self.metric) // self.batch_size
+        for _ in range(n_batches):
+            pool = self._eligible()
+            idx = rng.choice(pool, size=self.batch_size,
+                             replace=len(pool) < self.batch_size)
+            self.global_step += 1
+            yield idx
+        self.epoch += 1
+
+    def state_dict(self):
+        return {"global_step": self.global_step, "epoch": self.epoch}
+
+    def load_state_dict(self, sd):
+        self.global_step = int(sd["global_step"])
+        self.epoch = int(sd["epoch"])
+
+
+class RandomLTDScheduler:
+    """Kept-token schedule for random layerwise token dropping (reference
+    ``data_routing/scheduler.py`` RandomLTDScheduler; kernels
+    ``csrc/random_ltd/``). Value = number of tokens the middle layers keep;
+    rises from ``min_value`` to ``max_value`` (full sequence) by
+    ``seq_per_step`` every ``require_steps`` steps (fixed_linear)."""
+
+    def __init__(self, config: Dict[str, Any]):
+        self.min_value = int(config["min_value"])
+        self.max_value = int(config["max_value"])
+        sched = dict(config.get("schedule_config", {}))
+        self.seq_per_step = int(sched.get("seq_per_step", 16))
+        self.require_steps = int(sched.get("require_steps", 100))
+        self.schedule_type = config.get("schedule_type", FIXED_LINEAR)
+        if self.schedule_type != FIXED_LINEAR:
+            raise ValueError("random-ltd supports fixed_linear schedules")
+        self.current_value = self.min_value
+
+    def get_value(self, global_steps: int) -> int:
+        inc = (global_steps // max(self.require_steps, 1)) * self.seq_per_step
+        self.current_value = int(min(self.min_value + inc, self.max_value))
+        return self.current_value
+
+    def state_dict(self):
+        return {"current_value": self.current_value}
+
+    def load_state_dict(self, sd):
+        self.current_value = int(sd["current_value"])

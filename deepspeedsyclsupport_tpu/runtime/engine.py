@@ -114,6 +114,13 @@ class Engine:
                  module: Any = None):
         self.module = module
         self.loss_fn_raw = loss_fn
+        import inspect
+
+        try:
+            self._loss_accepts_train = "train" in inspect.signature(
+                loss_fn).parameters
+        except (TypeError, ValueError):
+            self._loss_accepts_train = False
         self.config = DSTpuConfig.from_config(config)
 
         # ---------------------------------------------------------- topology
@@ -251,6 +258,35 @@ class Engine:
             batch_size=self.config.train_batch_size,
             steps_per_output=self.config.steps_per_print)
         self.monitor = MonitorMaster(self.config.monitor)
+
+        # ------------------------------------------------- data efficiency
+        # (reference: deepspeed/runtime/data_pipeline/ — curriculum seqlen
+        # schedule + random-LTD token-drop schedule, both config-driven)
+        de = self.config.data_efficiency
+        self.curriculum_scheduler = None
+        self.random_ltd_scheduler = None
+        self._rltd_value = None
+        if de.curriculum is not None:
+            from .data_pipeline import CurriculumScheduler
+
+            self.curriculum_scheduler = CurriculumScheduler(de.curriculum)
+        if de.random_ltd is not None:
+            from .data_pipeline import RandomLTDScheduler
+
+            self.random_ltd_scheduler = RandomLTDScheduler(de.random_ltd)
+            mcfg = getattr(self.module, "config", None)
+            if mcfg is None:
+                raise ValueError("random_ltd needs a framework model "
+                                 "(models.CausalLM) to drive token dropping")
+            if not getattr(mcfg, "scan_layers", False) or \
+                    getattr(mcfg, "num_layers", 0) < 3:
+                raise ValueError(
+                    "random_ltd requires a scan_layers model with >= 3 "
+                    "layers (first/last stay dense; the middle stack drops "
+                    "tokens) — got scan_layers="
+                    f"{getattr(mcfg, 'scan_layers', None)}, num_layers="
+                    f"{getattr(mcfg, 'num_layers', None)}")
+            mcfg.random_ltd = True
         from ..profiling.flops_profiler import FlopsProfiler
 
         self.flops_profiler = FlopsProfiler(self)
@@ -418,8 +454,14 @@ class Engine:
             lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
             params)
 
-    def _loss_and_metrics(self, params, batch, rng):
-        out = self.loss_fn_raw(self._cast_params(params), batch, rng)
+    def _loss_and_metrics(self, params, batch, rng, train=True):
+        if self._loss_accepts_train:
+            out = self.loss_fn_raw(self._cast_params(params), batch, rng,
+                                   train=train)
+        else:
+            # user loss fns without a train flag (no train-time stochastic
+            # behavior to gate)
+            out = self.loss_fn_raw(self._cast_params(params), batch, rng)
         if isinstance(out, tuple):
             loss, metrics = out
             metrics = dict(metrics)
@@ -544,6 +586,20 @@ class Engine:
         ``(gas, step_batch, ...)`` and scans). The analog of the reference loop
         forward→backward→step and of ``PipelineEngine.train_batch``
         (``pipe/engine.py:321``)."""
+        if self.curriculum_scheduler is not None:
+            # seqlen curriculum: clip the batch before compile — each
+            # difficulty level is one compiled program (difficulty_step
+            # bounds the number of levels)
+            d = self.curriculum_scheduler.update_difficulty(self.global_steps)
+            from .data_pipeline import truncate_to_difficulty
+
+            batch = truncate_to_difficulty(batch, d)
+        if self.random_ltd_scheduler is not None:
+            v = self.random_ltd_scheduler.get_value(self.global_steps)
+            if v != self._rltd_value:
+                self._rltd_value = v
+                self.module.config.random_ltd_current = v
+                self._train_batch_fn = None  # retrace at the new keep count
         if self._train_batch_fn is None and self.offload_device is None:
             self._train_batch_fn = self._build_train_batch_fn()
         gas = self.config.gradient_accumulation_steps
@@ -576,7 +632,8 @@ class Engine:
         for the subsequent :meth:`backward`."""
         if self._eval_fn is None:
             self._eval_fn = jax.jit(
-                lambda p, b, r: self._loss_and_metrics(p, b, r)[0])
+                lambda p, b, r: self._loss_and_metrics(p, b, r,
+                                                       train=False)[0])
         self.timers(FORWARD_GLOBAL_TIMER).start()
         self._last_batch = batch
         loss = self._eval_fn(self.params, batch,
@@ -767,6 +824,10 @@ class Engine:
                 "skipped_steps": self.skipped_steps,
                 "config": {"zero_stage": self.zero_stage},
                 "client_state": client_state or {}}
+        if self.curriculum_scheduler is not None:
+            meta["curriculum"] = self.curriculum_scheduler.state_dict()
+        if self.random_ltd_scheduler is not None:
+            meta["random_ltd"] = self.random_ltd_scheduler.state_dict()
         save_tree(path, state, meta)
         if self._swapper is not None:
             self._swap_out_opt_state()
@@ -825,6 +886,10 @@ class Engine:
                 self.scaler_state = state["scaler"]
         self.global_steps = meta.get("global_steps", 0)
         self.micro_steps = meta.get("micro_steps", 0)
+        if self.curriculum_scheduler is not None and "curriculum" in meta:
+            self.curriculum_scheduler.load_state_dict(meta["curriculum"])
+        if self.random_ltd_scheduler is not None and "random_ltd" in meta:
+            self.random_ltd_scheduler.load_state_dict(meta["random_ltd"])
         # skipped_steps rides in scaler_state.overflows, restored above
         log_dist(f"loaded checkpoint {path}")
         return path, meta.get("client_state", {})
@@ -886,7 +951,8 @@ class Engine:
         batch for backward(), unlike :meth:`forward`)."""
         if self._eval_fn is None:
             self._eval_fn = jax.jit(
-                lambda p, b, r: self._loss_and_metrics(p, b, r)[0])
+                lambda p, b, r: self._loss_and_metrics(p, b, r,
+                                                       train=False)[0])
         return self._eval_fn(self.params, batch,
                              jax.random.fold_in(self._rng, self.micro_steps))
 

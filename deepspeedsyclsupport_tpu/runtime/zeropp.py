@@ -128,13 +128,20 @@ def build_zeropp_train_fn(engine):
         return treedef.unflatten(
             [f(x, s) for x, s in zip(leaves, spec_leaves)])
 
+    def _wire_bytes(size, dtype, quantized):
+        """Per-device payload: int8 elements + one fp32 scale per block, or
+        the element dtype as-is."""
+        if quantized:
+            return size + (-(-size // group_size)) * 4
+        return size * jnp.dtype(dtype).itemsize
+
     def gather_leaf(x, spec):
         k = _fsdp_dim(spec)
         if k is None:
             return x
         moved = jnp.moveaxis(x, k, 0)
         comms_logger.append("zeropp_gather" + ("_int8" if qw else ""),
-                            AXIS, moved.size * (1 if qw else 4) * n,
+                            AXIS, _wire_bytes(moved.size, moved.dtype, qw) * n,
                             tuple(moved.shape))
         full = hierarchical_all_gather(moved, n, h, qw, group_size)
         return jnp.moveaxis(full, 0, k)
@@ -146,7 +153,7 @@ def build_zeropp_train_fn(engine):
             return lax.pmean(g, AXIS)
         moved = jnp.moveaxis(g, k, 0)
         comms_logger.append("zeropp_reduce" + ("_int8" if qg else ""),
-                            AXIS, moved.size * (1 if qg else 4),
+                            AXIS, _wire_bytes(moved.size, moved.dtype, qg),
                             tuple(moved.shape))
         if qg:
             shard = all_to_all_quant_reduce(moved, AXIS,
@@ -167,7 +174,8 @@ def build_zeropp_train_fn(engine):
             (_, (loss, metrics)), grads = jax.value_and_grad(
                 scaled_loss, has_aux=True)(full_params)
             grads = jax.tree_util.tree_map(
-                lambda g: g.astype(jnp.float32), grads)
+                lambda g: g.astype(jnp.float32)
+                if jnp.issubdtype(g.dtype, jnp.floating) else g, grads)
             # reduce to shards NOW — the accumulator carries 1/N, the
             # explicit analog of per-bucket reduce inside backward
             shards = map_with_specs(reduce_leaf, grads)

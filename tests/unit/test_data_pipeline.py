@@ -1,0 +1,218 @@
+"""Data-efficiency pipeline tests (reference analogs:
+``tests/unit/runtime/test_data_efficiency.py`` — curriculum schedule math,
+scheduled seqlen reaching the engine's batches, random-LTD training)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeedsyclsupport_tpu as dstpu
+from deepspeedsyclsupport_tpu.models import build_model
+from deepspeedsyclsupport_tpu.runtime.data_pipeline import (
+    CurriculumDataSampler, CurriculumScheduler, RandomLTDScheduler,
+    truncate_to_difficulty)
+
+
+class TestCurriculumScheduler:
+    def test_fixed_linear_ramp_and_quantization(self):
+        s = CurriculumScheduler({
+            "min_difficulty": 8, "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100,
+                                "difficulty_step": 8}})
+        assert s.get_difficulty(0) == 8
+        assert s.get_difficulty(100) == 64
+        assert s.get_difficulty(1000) == 64
+        mid = s.get_difficulty(50)
+        assert 8 <= mid <= 64 and mid % 8 == 0
+
+    def test_fixed_root_faster_early(self):
+        common = dict(min_difficulty=0, max_difficulty=100,
+                      schedule_config={"total_curriculum_step": 100,
+                                       "difficulty_step": 1,
+                                       "root_degree": 2})
+        lin = CurriculumScheduler({**common, "schedule_type": "fixed_linear"})
+        root = CurriculumScheduler({**common, "schedule_type": "fixed_root"})
+        assert root.get_difficulty(25) > lin.get_difficulty(25)
+
+    def test_fixed_discrete(self):
+        s = CurriculumScheduler({
+            "min_difficulty": 1, "max_difficulty": 3,
+            "schedule_type": "fixed_discrete",
+            "schedule_config": {"difficulty": [1, 2, 3],
+                                "max_step": [5, 10]}})
+        assert s.get_difficulty(3) == 1
+        assert s.get_difficulty(7) == 2
+        assert s.get_difficulty(50) == 3
+
+    def test_missing_keys_raise(self):
+        with pytest.raises(ValueError, match="min_difficulty"):
+            CurriculumScheduler({"max_difficulty": 8,
+                                 "schedule_type": "fixed_linear"})
+
+    def test_state_roundtrip(self):
+        s = CurriculumScheduler({
+            "min_difficulty": 8, "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 10,
+                                "difficulty_step": 8}})
+        s.update_difficulty(10)
+        sd = s.state_dict()
+        s2 = CurriculumScheduler({
+            "min_difficulty": 8, "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 10,
+                                "difficulty_step": 8}})
+        s2.load_state_dict(sd)
+        assert s2.current_difficulty == 64
+
+
+class TestTruncate:
+    def test_clips_seq_dim_only(self):
+        batch = {"input_ids": np.zeros((4, 64), np.int32),
+                 "loss_mask": np.ones((4, 64), np.float32),
+                 "scalar": np.float32(3.0)}
+        out = truncate_to_difficulty(batch, 16)
+        assert out["input_ids"].shape == (4, 16)
+        assert out["loss_mask"].shape == (4, 16)
+        assert out["scalar"] == np.float32(3.0)
+
+
+class TestSampler:
+    def test_value_based_gating(self):
+        lengths = np.arange(100)  # metric = index
+        sched = CurriculumScheduler({
+            "min_difficulty": 10, "max_difficulty": 100,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 10,
+                                "difficulty_step": 10}})
+        sampler = CurriculumDataSampler(lengths, batch_size=4,
+                                        scheduler=sched, seed=0)
+        batches = list(iter(sampler))
+        # first batch drawn at difficulty 10 → only samples with metric <= 10
+        assert batches[0].max() <= 10
+        # later batches may use the full range
+        assert max(b.max() for b in batches) > 50
+
+    def test_deterministic(self):
+        def make():
+            sched = CurriculumScheduler({
+                "min_difficulty": 50, "max_difficulty": 100,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 5,
+                                    "difficulty_step": 10}})
+            return CurriculumDataSampler(np.arange(40), 4, sched, seed=3)
+
+        a = [b.tolist() for b in make()]
+        b = [b.tolist() for b in make()]
+        assert a == b
+
+
+class TestRandomLTDScheduler:
+    def test_linear_keep_schedule(self):
+        s = RandomLTDScheduler({
+            "min_value": 16, "max_value": 64,
+            "schedule_config": {"seq_per_step": 16, "require_steps": 2}})
+        assert s.get_value(0) == 16
+        assert s.get_value(2) == 32
+        assert s.get_value(100) == 64
+
+
+class TestEngineIntegration:
+    def test_curriculum_seqlen_reaches_batches(self):
+        model = build_model("tiny", num_layers=2)
+        config = {
+            "train_batch_size": 8,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "steps_per_print": 100,
+            "curriculum_learning": {
+                "enabled": True,
+                "min_difficulty": 16,
+                "max_difficulty": 64,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 3,
+                                    "difficulty_step": 16}},
+        }
+        engine, _, _, _ = dstpu.initialize(model=model, config=config)
+        assert engine.curriculum_scheduler is not None
+        ids = jax.random.randint(jax.random.PRNGKey(0), (8, 64), 0,
+                                 model.config.vocab_size)
+        seen = []
+        for _ in range(5):
+            m = engine.train_batch({"input_ids": ids})
+            assert np.isfinite(float(np.asarray(m["loss"])))
+            seen.append(engine.curriculum_scheduler.current_difficulty)
+        assert seen[0] == 16 and seen[-1] == 64  # ramp reached full length
+        assert sorted(seen) == seen              # monotone
+
+    def test_random_ltd_trains(self):
+        model = build_model("tiny", num_layers=4)
+        config = {
+            "train_batch_size": 8,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "steps_per_print": 100,
+            "data_efficiency": {
+                "enabled": True,
+                "data_routing": {"random_ltd": {
+                    "enabled": True,
+                    "min_value": 16,
+                    "max_value": 64,
+                    "schedule_config": {"seq_per_step": 16,
+                                        "require_steps": 2}}}},
+        }
+        engine, _, _, _ = dstpu.initialize(model=model, config=config)
+        assert engine.random_ltd_scheduler is not None
+        ids = jax.random.randint(jax.random.PRNGKey(1), (8, 64), 0,
+                                 model.config.vocab_size)
+        losses = [float(np.asarray(engine.train_batch({"input_ids": ids})["loss"]))
+                  for _ in range(5)]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+        # keep-count was scheduled upward and landed on the model config
+        assert model.config.random_ltd_current == 48
+
+    def test_random_ltd_full_keep_matches_dense(self):
+        """keep >= S must be exactly the normal forward."""
+        model = build_model("tiny", num_layers=4, dtype="float32")
+        params = model.init_params()
+        ids = jnp.asarray([[5, 9, 3, 7, 2, 8, 1, 4]], jnp.int32)
+        base = model.apply(params, ids)
+        model.config.random_ltd = True
+        model.config.random_ltd_current = 8  # == S: no drop
+        same = model.apply(params, ids)
+        np.testing.assert_allclose(np.asarray(base), np.asarray(same))
+
+    def test_random_ltd_subset_runs(self):
+        model = build_model("tiny", num_layers=4, dtype="float32")
+        model.config.random_ltd = True
+        model.config.random_ltd_current = 4
+        params = model.init_params()
+        ids = jnp.asarray([[5, 9, 3, 7, 2, 8, 1, 4]], jnp.int32)
+        logits = model.apply(params, ids)
+        assert logits.shape == (1, 8, model.config.vocab_size)
+        assert bool(jnp.isfinite(logits).all())
+
+    def test_scheduler_state_in_checkpoint(self, tmp_path):
+        model = build_model("tiny", num_layers=2)
+        config = {
+            "train_batch_size": 8,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "steps_per_print": 100,
+            "curriculum_learning": {
+                "enabled": True, "min_difficulty": 16, "max_difficulty": 64,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 3,
+                                    "difficulty_step": 16}},
+        }
+        engine, _, _, _ = dstpu.initialize(model=model, config=config)
+        ids = jax.random.randint(jax.random.PRNGKey(0), (8, 64), 0,
+                                 model.config.vocab_size)
+        for _ in range(4):
+            engine.train_batch({"input_ids": ids})
+        engine.save_checkpoint(str(tmp_path))
+
+        model2 = build_model("tiny", num_layers=2)
+        engine2, _, _, _ = dstpu.initialize(model=model2, config=config)
+        engine2.load_checkpoint(str(tmp_path))
+        assert engine2.curriculum_scheduler.current_difficulty == \
+            engine.curriculum_scheduler.current_difficulty
