@@ -41,6 +41,7 @@ class ModelConfig:
 
     # Training-time behavior
     remat: bool = False             # jax.checkpoint each layer (activation ckpt)
+    remat_policy: Optional[str] = None  # jax.checkpoint_policies name
     scan_layers: bool = True        # lax.scan over stacked layer params
     dropout: float = 0.0
     dtype: str = "bfloat16"         # compute dtype hint (engine may override)

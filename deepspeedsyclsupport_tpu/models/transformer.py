@@ -156,7 +156,10 @@ class CausalLM:
             return x, nck, ncv, aux
 
         if cfg.remat:
-            layer_fn = jax.checkpoint(layer_fn)
+            policy = None
+            if cfg.remat_policy and cfg.remat_policy != "nothing_saveable":
+                policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
+            layer_fn = jax.checkpoint(layer_fn, policy=policy)
 
         new_cache = None
         rltd_keep = cfg.random_ltd_current

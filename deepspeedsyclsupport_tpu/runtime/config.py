@@ -209,14 +209,27 @@ class ActivationCheckpointingConfig:
     cpu_checkpointing: bool = False
     policy: str = "nothing_saveable"  # jax.checkpoint policy name
 
+    # zero-arg jax.checkpoint_policies only — factory-style names (e.g.
+    # save_only_these_names) would be silently misused as policies
+    VALID_POLICIES = ("nothing_saveable", "everything_saveable",
+                      "dots_saveable", "checkpoint_dots",
+                      "dots_with_no_batch_dims_saveable",
+                      "checkpoint_dots_with_no_batch_dims")
+
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ActivationCheckpointingConfig":
+        policy = str(d.get("policy", "nothing_saveable"))
+        if policy not in cls.VALID_POLICIES:
+            raise ValueError(
+                f"activation_checkpointing.policy {policy!r} is not a "
+                f"supported jax.checkpoint policy; choose one of "
+                f"{cls.VALID_POLICIES}")
         return cls(partition_activations=bool(d.get("partition_activations", False)),
                    number_checkpoints=d.get("number_checkpoints"),
                    contiguous_memory_optimization=bool(
                        d.get("contiguous_memory_optimization", False)),
                    cpu_checkpointing=bool(d.get("cpu_checkpointing", False)),
-                   policy=str(d.get("policy", "nothing_saveable")))
+                   policy=policy)
 
 
 @dataclass

@@ -259,6 +259,27 @@ class Engine:
             steps_per_output=self.config.steps_per_print)
         self.monitor = MonitorMaster(self.config.monitor)
 
+        # -------------------------------------------- activation checkpointing
+        # (reference runtime/activation_checkpointing/: config-driven
+        # save/recompute; here the section turns on jax.checkpoint around
+        # each model layer and selects the rematerialization policy)
+        if "activation_checkpointing" in self.config.raw:
+            ac = self.config.activation_checkpointing
+            mcfg = getattr(self.module, "config", None)
+            if mcfg is not None and hasattr(mcfg, "remat"):
+                mcfg.remat = True
+                mcfg.remat_policy = ac.policy
+                log_dist(f"activation checkpointing on "
+                         f"(policy={ac.policy})")
+            else:
+                logger.warning(
+                    "activation_checkpointing configured but the model does "
+                    "not expose a remat flag; apply jax.checkpoint in your "
+                    "model instead")
+            if ac.cpu_checkpointing:
+                logger.warning("cpu_checkpointing has no TPU analog yet; "
+                               "activations recompute instead of offloading")
+
         # ------------------------------------------------- data efficiency
         # (reference: deepspeed/runtime/data_pipeline/ — curriculum seqlen
         # schedule + random-LTD token-drop schedule, both config-driven)

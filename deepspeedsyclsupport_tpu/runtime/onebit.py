@@ -20,6 +20,7 @@ from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from ..comm.quantized import compressed_allreduce
@@ -113,3 +114,342 @@ def onebit_adam(learning_rate, b1: float = 0.9, b2: float = 0.999,
         txs.append(optax.add_decayed_weights(weight_decay))
     txs.append(optax.scale_by_learning_rate(learning_rate))
     return optax.chain(*txs)
+
+
+def _compress(x, e, axis_name):
+    """Shared 1-bit dispatch: sign+scale+error-feedback locally, or the
+    wire-compressed allreduce over ``axis_name`` inside shard_map. One
+    implementation — the sign/scale convention must agree everywhere or
+    error feedback breaks (see ``sign_compress``)."""
+    from ..comm.quantized import sign_compress
+
+    if axis_name is not None:
+        return compressed_allreduce(x, e, axis_name)
+    sign, scale, residual = sign_compress(x + e)
+    return scale * sign.astype(jnp.float32), residual
+
+
+def _map_unzip(fn, n_out, *trees):
+    """tree_map for multi-output leaf fns, robust to tuple-valued pytrees
+    (the naive ``is_leaf=isinstance(tuple)`` trick misparses params that are
+    themselves tuples). Returns ``n_out`` trees shaped like ``trees[0]``."""
+    treedef = jax.tree_util.tree_structure(trees[0])
+    leaves = [jax.tree_util.tree_leaves(t) for t in trees]
+    assert all(len(l) == len(leaves[0]) for l in leaves)
+    outs = [fn(*args) for args in zip(*leaves)]
+    return tuple(jax.tree_util.tree_unflatten(treedef, [o[i] for o in outs])
+                 for i in range(n_out))
+
+
+class OneBitLambState(NamedTuple):
+    count: jnp.ndarray
+    mu: optax.Updates
+    nu: optax.Updates            # frozen after freeze_step
+    nu_fresh: optax.Updates      # keeps tracking via reconstructed grads
+    error: optax.Updates         # compression residual
+    scaling_coeff: optax.Updates   # per-leaf scalar, set at the freeze step
+    lamb_coeff_freeze: optax.Updates  # per-leaf EMA of the warmup lamb coeff
+    last_factor: optax.Updates       # per-leaf factor rate limiter
+
+
+def onebit_lamb(learning_rate, b1: float = 0.9, b2: float = 0.999,
+                eps: float = 1e-8, freeze_step: int = 100,
+                weight_decay: float = 0.0,
+                max_coeff: float = 10.0, min_coeff: float = 0.01,
+                coeff_beta: float = 0.9, factor_max: float = 4.0,
+                factor_min: float = 0.5, factor_threshold: float = 0.1,
+                axis_name: Optional[str] = None
+                ) -> optax.GradientTransformation:
+    """1-bit LAMB (reference ``OnebitLamb``, ``runtime/fp16/onebit/lamb.py``).
+
+    Warmup runs baseline LAMB (dense-synced grads when ``axis_name`` is
+    given) while an EMA of the clipped trust ratio is collected per leaf
+    (``coeff_beta``, reference ``lamb.py:238-240``). At the freeze step the
+    variance freezes and per-leaf ``scaling_coeff`` = united-scale /
+    leaf-momentum-scale balances compression error across leaves
+    (``lamb.py:171-181``). Afterwards momentum updates use LOCAL gradients
+    and synchronize ONLY through the 1-bit compressed operator (the whole
+    point of the algorithm — the reference does the same switch); a fresh
+    variance tracks reconstructed gradients and the trust ratio becomes
+    ``lamb_coeff_freeze × factor`` with ``factor = clip(max(frozen_denom /
+    fresh_denom))`` rate-limited by ``factor_threshold``
+    (``lamb.py:333-360``).
+
+    Consumes the learning rate internally (the trust ratio composes with
+    it); do NOT chain a separate ``scale_by_learning_rate``.
+    """
+
+    def lr_at(count):
+        return learning_rate(count) if callable(learning_rate) else learning_rate
+
+    def init_fn(params):
+        zeros = lambda: jax.tree_util.tree_map(  # noqa: E731
+            lambda p: jnp.zeros_like(p, jnp.float32), params)
+        scalars = lambda v: jax.tree_util.tree_map(  # noqa: E731
+            lambda _: jnp.asarray(v, jnp.float32), params)
+        return OneBitLambState(jnp.zeros((), jnp.int32), zeros(), zeros(),
+                               zeros(), zeros(), scalars(1.0), scalars(0.0),
+                               scalars(1.0))
+
+    def update_fn(updates, state, params):
+        if params is None:
+            raise ValueError("onebit_lamb needs params (trust ratio)")
+        count = state.count + 1
+        in_warmup = count <= freeze_step
+        at_freeze = count == freeze_step
+        lr = lr_at(state.count)
+
+        # dense sync only in warmup; post-freeze the compressed momentum
+        # collective is the ONLY cross-rank communication
+        if axis_name is not None:
+            g_dense = jax.tree_util.tree_map(
+                lambda u: jax.lax.pmean(u, axis_name), updates)
+        else:
+            g_dense = updates
+        g_local = updates
+
+        # ---------------- warmup: baseline LAMB + coeff EMA ----------------
+        mu_w = jax.tree_util.tree_map(
+            lambda m, gg: b1 * m + (1 - b1) * gg.astype(jnp.float32),
+            state.mu, g_dense)
+        nu_w = jax.tree_util.tree_map(
+            lambda v, gg: b2 * v + (1 - b2) * jnp.square(
+                gg.astype(jnp.float32)), state.nu, g_dense)
+
+        def warm_leaf(m, v, p, coeff_ema):
+            upd = m / (jnp.sqrt(v) + eps)
+            if weight_decay > 0.0:
+                upd = upd + weight_decay * p.astype(jnp.float32)
+            w_norm = jnp.sqrt(jnp.sum(jnp.square(p.astype(jnp.float32))))
+            u_norm = jnp.sqrt(jnp.sum(jnp.square(upd)))
+            coeff = jnp.clip(w_norm / jnp.maximum(u_norm, 1e-12),
+                             min_coeff, max_coeff)
+            coeff = jnp.where((w_norm > 0) & (u_norm > 0), coeff, 1.0)
+            new_ema = jnp.where(
+                coeff != 1.0,
+                coeff_beta * coeff_ema + (1 - coeff_beta) * coeff, coeff_ema)
+            return -lr * coeff * upd, new_ema
+
+        warm_delta, warm_ema = _map_unzip(warm_leaf, 2, mu_w, nu_w, params,
+                                          state.lamb_coeff_freeze)
+
+        # scaling coeff at the freeze transition (lamb.py:171-181) — a full
+        # tree reduction, gated behind lax.cond so it costs nothing on the
+        # other steps
+        def compute_scaling(_):
+            mu_leaves = jax.tree_util.tree_leaves(mu_w)
+            scales = [jnp.sqrt(jnp.sum(jnp.square(m))) / np.sqrt(m.size)
+                      for m in mu_leaves]
+            united = sum(scales) / len(scales)
+            treedef = jax.tree_util.tree_structure(state.mu)
+            return jax.tree_util.tree_unflatten(
+                treedef, [united / jnp.maximum(s, 1e-12) for s in scales])
+
+        scaling = jax.lax.cond(at_freeze, compute_scaling,
+                               lambda _: state.scaling_coeff, None)
+
+        # ---------------- compression stage --------------------------------
+        def comp_leaf(m_prev, gg, e, sc, v_frozen, v_fresh, p, coeff_ema,
+                      last_f):
+            m_local = (b1 * m_prev + (1 - b1) * gg.astype(jnp.float32)) * sc
+            m_synced, new_e = _compress(m_local, e, axis_name)
+            m_eff = m_synced / sc
+            grad_recon = (m_eff - b1 * m_prev) / (1 - b1)
+            v_fresh_new = b2 * v_fresh + (1 - b2) * jnp.square(grad_recon)
+            denom = jnp.sqrt(v_frozen) + eps
+            denom_real = jnp.sqrt(v_fresh_new) + eps
+            prelim = m_eff / denom
+            upd = prelim + (weight_decay * p.astype(jnp.float32)
+                            if weight_decay > 0.0 else 0.0)
+            factor = jnp.clip(jnp.max(denom / denom_real), factor_min,
+                              factor_max)
+            if weight_decay > 0.0:
+                ratio = jnp.minimum(
+                    1.0, jnp.sqrt(jnp.sum(jnp.square(prelim))) /
+                    jnp.maximum(jnp.sqrt(jnp.sum(jnp.square(upd))), 1e-12))
+                factor = factor * ratio + (1.0 - ratio)
+            factor = jnp.clip(factor, last_f * (1.0 - factor_threshold),
+                              last_f * (1.0 + factor_threshold))
+            coeff = coeff_ema * factor
+            return -lr * coeff * upd, m_eff, new_e, v_fresh_new, factor
+
+        c_delta, c_mu, c_err, c_fresh, c_factor = _map_unzip(
+            comp_leaf, 5, state.mu, g_local, state.error, scaling,
+            state.nu, state.nu_fresh, params, state.lamb_coeff_freeze,
+            state.last_factor)
+
+        sel = lambda a, b: jax.tree_util.tree_map(  # noqa: E731
+            lambda x, y: jnp.where(in_warmup, x, y), a, b)
+        delta = sel(warm_delta, c_delta)
+        mu = sel(mu_w, c_mu)
+        error = sel(jax.tree_util.tree_map(jnp.zeros_like, state.error),
+                    c_err)
+        nu = jax.tree_util.tree_map(
+            lambda v_new, v_old: jnp.where(in_warmup, v_new, v_old),
+            nu_w, state.nu)
+        # nu_fresh: snapshots nu at the freeze step, then tracks recon grads
+        nu_fresh = jax.tree_util.tree_map(
+            lambda snap, keep, fresh: jnp.where(
+                in_warmup, jnp.where(at_freeze, snap, keep), fresh),
+            nu_w, state.nu_fresh, c_fresh)
+        last_factor = sel(state.last_factor, c_factor)
+        ema = sel(warm_ema, state.lamb_coeff_freeze)
+        delta = jax.tree_util.tree_map(
+            lambda d, u: d.astype(u.dtype), delta, updates)
+        return delta, OneBitLambState(count, mu, nu, nu_fresh, error,
+                                      scaling, ema, last_factor)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class ZeroOneAdamState(NamedTuple):
+    count: jnp.ndarray
+    mu: optax.Updates
+    nu: optax.Updates
+    error: optax.Updates
+    comm_buffer: optax.Updates   # 'u' accumulator of local deltas
+    lrs: jnp.ndarray             # accumulated learning rate since last sync
+    var_interval: jnp.ndarray    # int32
+    var_counter: jnp.ndarray
+    local_interval: jnp.ndarray
+    local_counter: jnp.ndarray
+
+
+def zero_one_adam(learning_rate, b1: float = 0.9, b2: float = 0.999,
+                  eps: float = 1e-8,
+                  var_freeze_step: int = 100000,
+                  var_update_scaler: int = 16,
+                  local_step_scaler: int = 32678,
+                  local_step_clipper: int = 16,
+                  weight_decay: float = 0.0,
+                  axis_name: Optional[str] = None
+                  ) -> optax.GradientTransformation:
+    """0/1 Adam (reference ``ZeroOneAdam``, ``runtime/fp16/onebit/zoadam.py``).
+
+    Variance updates follow the exponential-interval policy (interval
+    doubles every ``var_update_scaler`` occurrences) and freeze past
+    ``var_freeze_step``. Communication policy with ``axis_name``: variance
+    steps sync gradients densely; the in-between pre-freeze steps ship
+    1-bit gradients; post-freeze steps are fully LOCAL — parameters advance
+    on local momentum while an accumulator collects the deltas, and every
+    ``local_interval`` steps (doubling every ``local_step_scaler``, clipped
+    at ``local_step_clipper``) the accumulated trajectory is re-synchronized
+    through the compressed operator and momentum is rebuilt from it
+    (``zoadam.py:243-259``). Without ``axis_name`` (the GSPMD engine) the
+    same structure applies the compression operator locally.
+
+    Consumes the learning rate internally (the local-step correction needs
+    it); do NOT chain a separate ``scale_by_learning_rate``.
+    """
+
+    def lr_at(count):
+        return learning_rate(count) if callable(learning_rate) else learning_rate
+
+    def init_fn(params):
+        zeros = lambda: jax.tree_util.tree_map(  # noqa: E731
+            lambda p: jnp.zeros_like(p, jnp.float32), params)
+        one = jnp.ones((), jnp.int32)
+        zero = jnp.zeros((), jnp.int32)
+        return ZeroOneAdamState(zero, zeros(), zeros(), zeros(), zeros(),
+                                jnp.zeros((), jnp.float32), one, zero, one,
+                                zero)
+
+    def update_fn(updates, state, params=None):
+        if params is None and weight_decay > 0.0:
+            raise ValueError("zero_one_adam with weight_decay needs params")
+        count = state.count + 1
+        lr = lr_at(state.count)
+        frozen = count > var_freeze_step
+        var_step = jnp.logical_and(~frozen, count % state.var_interval == 0)
+        sync_step = jnp.logical_and(frozen,
+                                    count % state.local_interval == 0)
+        # the error buffer switches metrics at the freeze (gradients →
+        # accumulated momentum); the reference re-initializes it
+        # (zoadam.py reinitial_error_buffer) — carry-over residuals at the
+        # wrong scale destabilize the first syncs
+        first_frozen = count == var_freeze_step + 1
+        state = state._replace(error=jax.tree_util.tree_map(
+            lambda e: jnp.where(first_frozen, jnp.zeros_like(e), e),
+            state.error))
+
+        # dense gradient sync ONLY on variance-update steps (zoadam's
+        # enable_backward_allreduce toggling); other pre-freeze steps ship
+        # 1-bit gradients; post-freeze steps are local
+        if axis_name is not None:
+            g_dense = jax.tree_util.tree_map(
+                lambda u: jax.lax.pmean(u, axis_name), updates)
+        else:
+            g_dense = updates
+        g_local = updates
+
+        def mu_leaf(m, gd, gl, e):
+            gf_d = gd.astype(jnp.float32)
+            gf_l = gl.astype(jnp.float32)
+            g1, e1 = _compress(gf_l, e, axis_name)
+            g_eff = jnp.where(var_step, gf_d, jnp.where(frozen, gf_l, g1))
+            new_e = jnp.where(var_step | frozen, e, e1)
+            return b1 * m + (1 - b1) * g_eff, new_e
+
+        mu, error = _map_unzip(mu_leaf, 2, state.mu, g_dense, g_local,
+                               state.error)
+        nu = jax.tree_util.tree_map(
+            lambda v, gg: jnp.where(
+                var_step, b2 * v + (1 - b2) * jnp.square(
+                    gg.astype(jnp.float32)), v),
+            state.nu, g_dense)
+
+        if params is None:
+            local_delta = jax.tree_util.tree_map(
+                lambda m, v: -lr * (m / (jnp.sqrt(v) + eps)), mu, nu)
+        else:
+            local_delta = jax.tree_util.tree_map(
+                lambda m, v, p: -lr * (
+                    m / (jnp.sqrt(v) + eps)
+                    + weight_decay * p.astype(jnp.float32)), mu, nu, params)
+        # post-freeze: accumulate local deltas toward the next sync
+        buf = jax.tree_util.tree_map(
+            lambda b, d: jnp.where(frozen, b + d, b),
+            state.comm_buffer, local_delta)
+        lrs = jnp.where(frozen, state.lrs + lr, state.lrs)
+
+        # sync step: undo the accumulated local trajectory, re-apply its
+        # compressed-synced version, rebuild momentum from it
+        def sync_leaf(d, b, v, e, m):
+            denom = jnp.sqrt(v) + eps
+            b_scaled = b * denom
+            b_synced, new_e = _compress(b_scaled, e, axis_name)
+            delta_sync = d - b + b_synced / denom
+            m_new = -b_synced / jnp.maximum(lrs, 1e-12)
+            out_d = jnp.where(sync_step, delta_sync, d)
+            out_e = jnp.where(sync_step, new_e, e)
+            out_m = jnp.where(sync_step, m_new, m)
+            out_b = jnp.where(sync_step, jnp.zeros_like(b), b)
+            return out_d, out_e, out_m, out_b
+
+        delta, error, mu, buf = _map_unzip(sync_leaf, 4, local_delta, buf,
+                                           nu, error, mu)
+        lrs = jnp.where(sync_step, 0.0, lrs)
+
+        # interval bookkeeping (zoadam.py:265-286)
+        var_counter = jnp.where(var_step, state.var_counter + 1,
+                                state.var_counter)
+        bump_var = var_counter == var_update_scaler
+        var_interval = jnp.where(bump_var, state.var_interval * 2,
+                                 state.var_interval)
+        var_counter = jnp.where(bump_var, 0, var_counter)
+        local_counter = jnp.where(frozen, state.local_counter + 1,
+                                  state.local_counter)
+        bump_loc = local_counter == local_step_scaler
+        local_interval = jnp.where(
+            bump_loc, jnp.minimum(local_step_clipper,
+                                  state.local_interval * 2),
+            state.local_interval)
+        local_counter = jnp.where(bump_loc, 0, local_counter)
+
+        delta = jax.tree_util.tree_map(
+            lambda d, u: d.astype(u.dtype), delta, updates)
+        return delta, ZeroOneAdamState(count, mu, nu, error, buf, lrs,
+                                       var_interval, var_counter,
+                                       local_interval, local_counter)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -56,13 +56,9 @@ def build_optimizer(opt_type: str, params: Dict[str, Any],
     lr, betas, eps, wd = _common(params)
     schedule = lr_schedule if lr_schedule is not None else lr
 
-    if t in (ONEBIT_ADAM, ZERO_ONE_ADAM):
+    if t == ONEBIT_ADAM:
         from .onebit import onebit_adam
 
-        if t == ZERO_ONE_ADAM:
-            logger.warning(
-                "ZeroOneAdam approximated by 1-bit Adam (fixed freeze_step "
-                "instead of 0/1's adaptive variance-freeze/sync policies)")
         # static_args: only the LR is a traced hyperparam — the rest gate
         # python control flow in the factory and must stay concrete under jit
         return optax.inject_hyperparams(
@@ -70,10 +66,29 @@ def build_optimizer(opt_type: str, params: Dict[str, Any],
             static_args=("b1", "b2", "eps", "freeze_step", "weight_decay"))(
             learning_rate=schedule, b1=betas[0], b2=betas[1], eps=eps,
             freeze_step=int(params.get("freeze_step", 100)), weight_decay=wd)
+    if t == ZERO_ONE_ADAM:
+        from .onebit import zero_one_adam
+
+        return zero_one_adam(
+            schedule, b1=betas[0], b2=betas[1], eps=eps,
+            var_freeze_step=int(params.get("var_freeze_step", 100000)),
+            var_update_scaler=int(params.get("var_update_scaler", 16)),
+            local_step_scaler=int(params.get("local_step_scaler", 32678)),
+            local_step_clipper=int(params.get("local_step_clipper", 16)),
+            weight_decay=wd)
     if t == ONEBIT_LAMB:
-        logger.warning("%s resolves to lamb on TPU (compressed-momentum LAMB "
-                       "pending)", opt_type)
-        t = LAMB_OPTIMIZER
+        from .onebit import onebit_lamb
+
+        return onebit_lamb(
+            schedule, b1=betas[0], b2=betas[1], eps=eps,
+            freeze_step=int(params.get("freeze_step", 100)),
+            weight_decay=wd,
+            max_coeff=float(params.get("max_coeff", 10.0)),
+            min_coeff=float(params.get("min_coeff", 0.01)),
+            coeff_beta=float(params.get("coeff_beta", 0.9)),
+            factor_max=float(params.get("factor_max", 4.0)),
+            factor_min=float(params.get("factor_min", 0.5)),
+            factor_threshold=float(params.get("factor_threshold", 0.1)))
 
     if t in (ADAMW_OPTIMIZER, FUSED_ADAM, CPU_ADAM):
         # reference FusedAdam defaults adam_w_mode=True → AdamW semantics

@@ -201,3 +201,24 @@ def test_lr_schedule_in_engine():
                        steps=3)
     lr = engine.get_lr()
     assert 0.0 < lr < 0.01  # mid-warmup
+
+
+def test_activation_checkpointing_config_drives_remat():
+    """The activation_checkpointing section must actually turn on remat
+    (regression: it was parsed but nothing read it)."""
+    from deepspeedsyclsupport_tpu.models import build_model
+
+    model = build_model("tiny", num_layers=2)
+    assert model.config.remat is False
+    cfg = simple_config(activation_checkpointing={
+        "partition_activations": True, "policy": "dots_saveable"})
+    cfg["train_batch_size"] = 16
+    engine, _, _, _ = dstpu.initialize(model=model, config=cfg)
+    assert model.config.remat is True
+    assert model.config.remat_policy == "dots_saveable"
+    import jax
+
+    ids = jax.random.randint(jax.random.PRNGKey(0), (16, 32), 0,
+                             model.config.vocab_size)
+    m = engine.train_batch({"input_ids": ids})
+    assert np.isfinite(float(np.asarray(m["loss"])))

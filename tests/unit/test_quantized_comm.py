@@ -231,3 +231,62 @@ class TestOneBitAdam:
         traj = np.asarray(per_rank).reshape(8, 8, 16)
         for r in range(1, 8):
             np.testing.assert_allclose(traj[r], traj[0], rtol=1e-5, atol=1e-6)
+
+
+class TestOneBitLamb:
+    def _fit(self, opt_type, opt_params=None, steps=40):
+        import deepspeedsyclsupport_tpu as dstpu
+        from .simple_model import SimpleModel, random_dataset, simple_config
+
+        model = SimpleModel(hidden_dim=32)
+        cfg = simple_config(optimizer={
+            "type": opt_type,
+            "params": {"lr": 1e-2, **(opt_params or {})}})
+        engine, _, _, _ = dstpu.initialize(model=model, config=cfg)
+        data = random_dataset(engine.train_batch_size(), hidden_dim=32,
+                              n_batches=steps)
+        return [float(np.asarray(engine.train_batch(b)["loss"]))
+                for b in data]
+
+    def test_onebit_lamb_converges_through_freeze(self):
+        """Warmup LAMB → freeze transition → compressed-momentum stage, all
+        inside one run (reference tests/unit/onebit/test_onebit.py shape)."""
+        losses = self._fit("OneBitLamb", {"freeze_step": 10}, steps=60)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.85, losses
+        # still improving after the freeze transition
+        assert min(losses[12:]) < min(losses[:10]), losses
+
+    def test_zero_one_adam_converges(self):
+        losses = self._fit("ZeroOneAdam", {
+            "var_freeze_step": 10, "var_update_scaler": 2,
+            "local_step_scaler": 4, "local_step_clipper": 4}, steps=40)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.7, losses
+
+    def test_onebit_lamb_state_shapes(self):
+        from deepspeedsyclsupport_tpu.runtime.onebit import onebit_lamb
+
+        params = {"w": jnp.ones((8, 8)), "b": jnp.zeros((8,))}
+        tx = onebit_lamb(1e-2, freeze_step=2)
+        state = tx.init(params)
+        # different per-leaf momentum magnitudes → non-trivial scaling coeffs
+        g = {"w": jnp.ones((8, 8)), "b": jnp.full((8,), 0.1)}
+        for _ in range(4):  # crosses the freeze boundary
+            delta, state = tx.update(g, state, params)
+            params = optax.apply_updates(params, delta)
+        assert int(state.count) == 4
+        # scaling coeff was set at the freeze step (no longer the 1.0 init)
+        sc = jax.tree_util.tree_leaves(state.scaling_coeff)
+        assert any(float(s) != 1.0 for s in sc)
+
+    def test_zero_one_adam_interval_growth(self):
+        from deepspeedsyclsupport_tpu.runtime.onebit import zero_one_adam
+
+        params = {"w": jnp.ones((4, 4))}
+        tx = zero_one_adam(1e-2, var_freeze_step=100, var_update_scaler=2)
+        state = tx.init(params)
+        g = jax.tree_util.tree_map(jnp.ones_like, params)
+        for _ in range(6):
+            _, state = tx.update(g, state, params)
+        assert int(state.var_interval) > 1  # exponential policy engaged
