@@ -39,6 +39,13 @@ def model_flops_per_token(cfg):
 
 def run_bench():
     import jax
+
+    # The axon sitecustomize force-sets jax_platforms at interpreter start,
+    # so the JAX_PLATFORMS env var alone cannot steer the child; re-pin via
+    # jax.config before any backend initializes.
+    plat_override = os.environ.get("JAX_PLATFORMS")
+    if plat_override:
+        jax.config.update("jax_platforms", plat_override)
     import numpy as np
 
     import deepspeedsyclsupport_tpu as ds
@@ -95,13 +102,13 @@ def run_bench():
     }))
 
 
-def _spawn(env_overrides):
+def _spawn(env_overrides, timeout=1500):
     env = dict(os.environ)
     env[CHILD_ENV] = "1"
     env.update(env_overrides)
     try:
         proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                              capture_output=True, text=True, timeout=3000,
+                              capture_output=True, text=True, timeout=timeout,
                               env=env)
     except subprocess.TimeoutExpired as e:
         return None, f"timeout: {e}"
@@ -117,15 +124,19 @@ def _spawn(env_overrides):
 
 
 def main():
+    # per-attempt timeouts: a HUNG tpu tunnel (observed: compute blocks
+    # forever while jax.devices() succeeds) must not eat the whole bench
+    # window before the cpu fallback gets its turn
     attempts = [
-        {},                           # native platform (TPU when present)
-        {},                           # once more: transient backend-init blips
-        {"JAX_PLATFORMS": ""},        # let jax auto-select any live backend
-        {"JAX_PLATFORMS": "cpu"},     # guaranteed-available degraded run
+        ({}, 1500),                       # native platform (TPU when present)
+        ({}, 1200),                       # once more: transient blips
+        # guaranteed-available degraded run (accelerator seam pinned too so
+        # topology building never probes the dead tunnel)
+        ({"JAX_PLATFORMS": "cpu", "DSTPU_ACCELERATOR": "cpu"}, 900),
     ]
     errors = []
-    for overrides in attempts:
-        line, err = _spawn(overrides)
+    for overrides, timeout in attempts:
+        line, err = _spawn(overrides, timeout)
         if line is not None:
             print(line)
             return

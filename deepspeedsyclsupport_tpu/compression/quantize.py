@@ -76,3 +76,84 @@ def dequantize_int8(q: jnp.ndarray, scale: jnp.ndarray, group_size: int = -1,
         out = qg.astype(jnp.float32) * scale[..., None]
         return out.reshape(shape).astype(dtype)
     return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantTensor:
+    """int8 weight + blockwise fp32 scales, as ONE pytree node.
+
+    The ZeRO-Inference weight format (reference
+    ``deepspeed/inference/quantization/``: quantized parameters living in the
+    module until the moment of use). Because it is a pytree node whose
+    children are the two arrays, a stacked ``[L, ...]`` quantized leaf
+    threads through ``lax.scan`` like any other — each layer's slice arrives
+    as a ``QuantTensor`` and is dequantized *inside* the scan body, so at
+    most one layer's weights exist dequantized at a time.
+    """
+
+    def __init__(self, q, scale, group_size: int):
+        self.q = q
+        self.scale = scale
+        self.group_size = int(group_size)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    def dequantize(self, dtype=jnp.bfloat16):
+        return dequantize_int8(self.q, self.scale,
+                               group_size=self.group_size, dtype=dtype)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), self.group_size
+
+    @classmethod
+    def tree_unflatten(cls, group_size, children):
+        return cls(children[0], children[1], group_size)
+
+    def __repr__(self):
+        return (f"QuantTensor(q={self.q.shape}, scale={self.scale.shape}, "
+                f"group={self.group_size})")
+
+
+def quantize_leaf(x, group_size: int = 64) -> "QuantTensor":
+    """Blockwise int8 quantization of one weight (last-dim groups; one scale
+    per row when the last dim doesn't divide — the scale must keep the
+    leading dims so stacked [L, ...] leaves stay scan-sliceable)."""
+    x = jnp.asarray(x)
+    gs = group_size if (group_size > 0 and x.ndim
+                        and x.shape[-1] % group_size == 0) else x.shape[-1]
+    q, scale = quantize_int8(x.astype(jnp.float32), group_size=gs)
+    return QuantTensor(q, scale, gs)
+
+
+def dequantize_tree(tree, dtype=jnp.bfloat16):
+    """Materialize any ``QuantTensor`` leaves (no-op for plain trees)."""
+    return jax.tree_util.tree_map(
+        lambda x: x.dequantize(dtype) if isinstance(x, QuantTensor) else x,
+        tree, is_leaf=lambda x: isinstance(x, QuantTensor))
+
+
+def quantize_tree(tree, group_size: int = 64, min_size: int = 4096,
+                  stacked: bool = False):
+    """Quantize matrix-shaped floating leaves with ``>= min_size`` elements.
+
+    Small or 1-D leaves — norm scales, biases — stay full precision, like
+    the reference keeps non-GEMM weights fp. ``stacked=True`` treats the
+    leading dim as the scan layer axis: both the size threshold and the
+    matrix-rank test apply per layer, so a stacked ``[L, hidden]`` norm
+    scale is (correctly) left alone.
+    """
+    import numpy as _np
+
+    def maybe(x):
+        if isinstance(x, QuantTensor):
+            return x
+        shape = _np.shape(x)
+        body = shape[1:] if (stacked and len(shape) > 1) else shape
+        if (hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+                and len(body) >= 2 and _np.prod(body) >= min_size):
+            return quantize_leaf(x, group_size)
+        return x
+
+    return jax.tree_util.tree_map(maybe, tree)

@@ -1,2 +1,3 @@
+from .elastic_agent import DSElasticAgent  # noqa: F401
 from .elasticity import (ElasticityConfigError, ElasticityError,  # noqa: F401
                          compute_elastic_config, get_compatible_gpus)

@@ -1,0 +1,137 @@
+"""Elastic training agent — fault-tolerant restart supervision.
+
+Analog of ``DSElasticAgent`` (reference ``elasticity/elastic_agent.py:28``, a
+torchelastic ``LocalElasticAgent`` subclass): monitor workers, and on failure
+re-admit the (possibly changed) membership and restart. The torchelastic
+rendezvous is replaced by plain re-discovery at restart time — JAX's
+coordinator-based ``jax.distributed`` has no dynamic membership, so an
+elastic event is a process-tree restart with a recomputed world:
+
+1. discover the current deployment size (env / hostfile),
+2. resolve the elastic batch config for it (``compute_elastic_config`` —
+   the same math the reference uses, ``elasticity/elasticity.py:233``),
+3. export it to the workers (``DSTPU_ELASTIC_*`` env), spawn the command,
+4. on a non-zero exit, loop — membership is re-discovered, the batch
+   config re-resolved, and the restarted run resumes from its latest
+   checkpoint (the engine's resharding-on-load makes topology-changing
+   resume work; reference needs universal checkpoints for this).
+"""
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from .elasticity import ElasticityError, compute_elastic_config
+from ..utils.logging import logger
+
+
+class DSElasticAgent:
+    """Supervise an elastic training command (reference ``DSElasticAgent``)."""
+
+    def __init__(self, cmd: Sequence[str], ds_config: Dict[str, Any],
+                 min_nodes: int = 1, max_nodes: int = -1,
+                 restart_limit: int = 3,
+                 backoff_seconds: float = 0.0,
+                 env: Optional[Dict[str, str]] = None,
+                 hostfile: Optional[str] = None):
+        self.cmd = list(cmd)
+        self.ds_config = ds_config
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes
+        self.restart_limit = restart_limit
+        self.backoff_seconds = backoff_seconds
+        self.extra_env = dict(env or {})
+        self.hostfile = hostfile
+        self.restart_count = 0
+        self.launch_history: List[Dict[str, Any]] = []
+
+    # ------------------------------------------------------------ membership
+    def discover_world_size(self) -> int:
+        """Chips in the current deployment: WORLD_SIZE env, hostfile slots,
+        or the local device count."""
+        if "WORLD_SIZE" in os.environ:
+            return int(os.environ["WORLD_SIZE"])
+        if self.hostfile and os.path.exists(self.hostfile):
+            from ..launcher.runner import parse_hostfile
+
+            return sum(slots for _, slots in parse_hostfile(self.hostfile))
+        import jax
+
+        return jax.device_count()
+
+    def _resolve(self, world: int) -> Dict[str, str]:
+        e = dict(self.ds_config.get("elasticity", {}))
+        if not e.get("enabled", False):
+            return {}
+        r = compute_elastic_config(self.ds_config,
+                                   target_deployment_size=world)
+        return {
+            "DSTPU_ELASTIC_BATCH": str(r.final_batch_size),
+            "DSTPU_ELASTIC_MICRO_BATCH": str(r.micro_batch_per_gpu),
+            "DSTPU_ELASTIC_GAS": str(r.gradient_accumulation_steps),
+        }
+
+    # ------------------------------------------------------------------ run
+    def run(self) -> int:
+        """Launch; restart on failure up to ``restart_limit`` times. Returns
+        the final exit code (0 on success)."""
+        while True:
+            world = self.discover_world_size()
+            if world < self.min_nodes:
+                raise ElasticityError(
+                    f"deployment of {world} below min_nodes {self.min_nodes}")
+            if 0 < self.max_nodes < world:
+                world = self.max_nodes
+            env = dict(os.environ)
+            env.update(self.extra_env)
+            env.update(self._resolve(world))
+            env["DSTPU_ELASTIC_RESTART_COUNT"] = str(self.restart_count)
+            env["DSTPU_ELASTIC_WORLD_SIZE"] = str(world)
+            logger.info("elastic agent: launching (attempt %d, world=%d)",
+                        self.restart_count + 1, world)
+            proc = subprocess.run(self.cmd, env=env)
+            self.launch_history.append(
+                {"world": world, "rc": proc.returncode,
+                 "restart": self.restart_count})
+            if proc.returncode == 0:
+                return 0
+            self.restart_count += 1
+            if self.restart_count > self.restart_limit:
+                logger.error("elastic agent: restart limit %d exhausted "
+                             "(last rc=%d)", self.restart_limit,
+                             proc.returncode)
+                return proc.returncode
+            logger.warning("elastic agent: worker failed rc=%d — "
+                           "re-discovering membership and restarting",
+                           proc.returncode)
+            if self.backoff_seconds:
+                time.sleep(self.backoff_seconds)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI: ``python -m deepspeedsyclsupport_tpu.elasticity.elastic_agent
+    --config ds_config.json [--restart-limit N] -- cmd args...``"""
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", required=True)
+    ap.add_argument("--restart-limit", type=int, default=3)
+    ap.add_argument("--min-nodes", type=int, default=1)
+    ap.add_argument("--max-nodes", type=int, default=-1)
+    ap.add_argument("--hostfile", default=None)
+    ap.add_argument("cmd", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    with open(args.config) as f:
+        ds_config = json.load(f)
+    cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
+    agent = DSElasticAgent(cmd, ds_config, min_nodes=args.min_nodes,
+                           max_nodes=args.max_nodes,
+                           restart_limit=args.restart_limit,
+                           hostfile=args.hostfile)
+    return agent.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,6 +37,28 @@ class TensorParallelConfig:
 
 
 @dataclass
+class ZeroInferenceQuantConfig:
+    """ZeRO-Inference weight quantization (reference
+    ``deepspeed/inference/quantization/`` + the v1 config ``quant`` section):
+    big weights live in HBM as int8 + blockwise scales and dequantize per
+    layer inside the scan."""
+    enabled: bool = False
+    group_size: int = 64    # elements per scale block
+    min_size: int = 4096    # leaves smaller than this stay full precision
+
+    @classmethod
+    def from_value(cls, v) -> "ZeroInferenceQuantConfig":
+        if isinstance(v, ZeroInferenceQuantConfig):
+            return v
+        if isinstance(v, bool):
+            return cls(enabled=v)
+        d = dict(v or {})
+        return cls(enabled=bool(d.get("enabled", False)),
+                   group_size=int(d.get("group_size", 64)),
+                   min_size=int(d.get("min_size", 4096)))
+
+
+@dataclass
 class DSTpuInferenceConfig:
     dtype: Any = jnp.bfloat16
     tensor_parallel: TensorParallelConfig = field(
@@ -48,6 +70,8 @@ class DSTpuInferenceConfig:
     eos_token_id: Optional[int] = None
     pad_token_id: int = 0
     seed: int = 0
+    quant: ZeroInferenceQuantConfig = field(
+        default_factory=ZeroInferenceQuantConfig)
 
     @classmethod
     def from_config(cls, config: Optional[Dict[str, Any]] = None, **kw
@@ -66,6 +90,8 @@ class DSTpuInferenceConfig:
             tp_cfg = TensorParallelConfig(**tp)
         if "mp_size" in cfg:  # reference legacy alias
             tp_cfg.tp_size = cfg.pop("mp_size")
+        quant = ZeroInferenceQuantConfig.from_value(cfg.pop("quant", None))
+        cfg["quant"] = quant
         dtype = cfg.pop("dtype", jnp.bfloat16)
         if isinstance(dtype, str):
             try:

@@ -108,12 +108,54 @@ class InferenceEngine:
         dtype = config.dtype
         self.params, self.param_shardings = place_inference_params(
             params, self.topology, rules, dtype)
+        if config.quant.enabled:
+            self._quantize_weights(config.quant)
         log_dist(f"inference engine: tp={tp}, dtype={jnp.dtype(dtype).name}, "
+                 f"quant={config.quant.enabled}, "
                  f"mesh={self.topology.axis_sizes}")
 
         self._forward_fn = None
         self._generate_fns: Dict[Tuple, Callable] = {}
         self._rng = jax.random.PRNGKey(config.seed)
+
+    def _quantize_weights(self, qcfg):
+        """ZeRO-Inference: per-layer weights → int8 + blockwise scales
+        (reference ``deepspeed/inference/quantization/``). Applied after
+        placement so scales stay fp32; dequantization happens inside the
+        model's layer scan (one layer fp at a time). TP is unsupported here
+        — the point of ZeRO-Inference is serving big models on FEW chips."""
+        if self.topology.axis_sizes["model"] > 1:
+            raise ValueError("weight quantization (ZeRO-Inference) does not "
+                             "compose with tensor_parallel yet")
+        from ..compression.quantize import quantize_tree
+
+        if not (isinstance(self.params, dict) and "layers" in self.params):
+            raise ValueError(
+                "weight quantization needs the framework model layout "
+                "(params['layers'] consumed by models.CausalLM, which "
+                "dequantizes inside its layer scan) — arbitrary models "
+                "would trace ops against QuantTensor leaves and fail")
+        stacked = bool(getattr(getattr(self.module, "config", None),
+                               "scan_layers", False))
+        nbytes = lambda t: sum(x.nbytes
+                               for x in jax.tree_util.tree_leaves(t))
+        before = nbytes(self.params["layers"])
+        self.params = dict(self.params)
+        # NOTE: no donation — placement may alias caller-held arrays
+        # (device_put of an already-placed array is a no-op), so the fp
+        # buffers are not ours to free. Transient peak during conversion is
+        # fp + int8; for models near the HBM limit quantize before placing.
+        self.params["layers"] = jax.jit(
+            lambda t: quantize_tree(t, qcfg.group_size, qcfg.min_size,
+                                    stacked=stacked))(self.params["layers"])
+        after = nbytes(self.params["layers"])
+        # shardings must mirror the (changed) params tree; tp==1 here, so
+        # everything is replicated
+        repl = self.topology.replicated()
+        self.param_shardings = jax.tree_util.tree_map(lambda _: repl,
+                                                      self.params)
+        log_dist(f"zero-inference: layer weights {before / 2**20:.1f} MB "
+                 f"→ {after / 2**20:.1f} MB int8")
 
     # ------------------------------------------------------------------ forward
     def forward(self, input_ids: jnp.ndarray) -> jnp.ndarray:

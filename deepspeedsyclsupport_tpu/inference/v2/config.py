@@ -22,6 +22,8 @@ class RaggedInferenceConfig:
     # memory_config-driven cache sizing)
     dtype: Any = jnp.bfloat16
     seed: int = 0
+    quantize_weights: bool = False   # ZeRO-Inference int8 layer weights
+    quant_group_size: int = 64
 
     def __post_init__(self):
         if self.num_blocks is None:

@@ -39,6 +39,19 @@ class InferenceEngineV2:
         rules = getattr(model, "sharding_rules", None)
         self.params, _ = place_inference_params(params, self.topology, rules,
                                                 cfg.dtype)
+        if cfg.quantize_weights and "layers" in self.params:
+            # ZeRO-Inference: int8 layer weights, dequantized per layer
+            # inside the ragged scan (model.py _dequant)
+            from ...compression.quantize import quantize_tree
+
+            stacked = bool(getattr(model.config, "scan_layers", False))
+            self.params = dict(self.params)
+            # no donation: placement may alias caller-held arrays (see
+            # InferenceEngine._quantize_weights)
+            self.params["layers"] = jax.jit(
+                lambda t: quantize_tree(t, cfg.quant_group_size,
+                                        stacked=stacked))(
+                self.params["layers"])
 
         self.kv = init_blocked_kv(model.config, cfg)
         self.allocator = BlockedAllocator(cfg.num_blocks)

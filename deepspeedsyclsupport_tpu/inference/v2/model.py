@@ -30,6 +30,13 @@ from .kv_cache import BlockedKV
 from ...models.layers import apply_rope, glu_mlp, rms_norm
 
 
+def _dequant(p, dtype):
+    """ZeRO-Inference: materialize int8 QuantTensor leaves per layer."""
+    from ...compression.quantize import dequantize_tree
+
+    return dequantize_tree(p, dtype)
+
+
 def _mlp(p, y, cfg):
     """Per-layer MLP over flat tokens [T, D]: dense GLU, or exact top-k MoE
     via grouped GEMMs (the moe_scatter/cutlass-multi-GEMM/moe_gather analog,
@@ -106,6 +113,7 @@ def ragged_forward(model, params: Any, kv: BlockedKV, tokens, token_seq,
 
     def layer(x, inp):
         p, k_cache, v_cache = inp
+        p = _dequant(p, x.dtype)
         y = rms_norm(x, p["attn_norm"]["scale"], cfg.rms_norm_eps)
         q = jnp.einsum("td,dq->tq", y, p["attn"]["wq"]).reshape(
             t, cfg.num_heads, cfg.head_dim)
@@ -174,6 +182,7 @@ def decode_forward(model, params: Any, kv: BlockedKV, tokens, positions,
 
     def layer(x, inp):
         p, k_cache, v_cache = inp
+        p = _dequant(p, x.dtype)
         y = rms_norm(x, p["attn_norm"]["scale"], cfg.rms_norm_eps)
         q = jnp.einsum("sd,dq->sq", y, p["attn"]["wq"]).reshape(
             s, cfg.num_heads, cfg.head_dim)

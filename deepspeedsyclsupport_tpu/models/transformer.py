@@ -109,6 +109,11 @@ class CausalLM:
                cache_slice, rng, kv_mask=None
                ) -> Tuple[jnp.ndarray, Any, jnp.ndarray]:
         cfg = self.config
+        # ZeRO-Inference: int8 QuantTensor leaves dequantize here, inside the
+        # layer scan — at most one layer's weights are fp at a time
+        from ..compression.quantize import dequantize_tree
+
+        p = dequantize_tree(p, jnp.dtype(cfg.dtype))
         dtype = x.dtype  # pin activation dtype: fp32 params must not promote bf16
         h, new_cache = attention_block(
             p["attn"], rms_norm(x, p["attn_norm"]["scale"], cfg.rms_norm_eps),

@@ -141,6 +141,7 @@ class ZeroConfig:
     zero_quantized_weights: bool = False    # qwZ: int8 weight all-gather
     zero_quantized_gradients: bool = False  # qgZ: int8 grad reduce
     zero_hpz_partition_size: int = 1        # hpZ: secondary shard group size
+    mics_shard_size: int = -1               # MiCS: sub-world shard groups
     overlap_comm: bool = True
     contiguous_gradients: bool = True
     reduce_bucket_size: int = 5 * 10**8
@@ -157,6 +158,7 @@ class ZeroConfig:
             zero_quantized_weights=bool(d.get("zero_quantized_weights", False)),
             zero_quantized_gradients=bool(d.get("zero_quantized_gradients", False)),
             zero_hpz_partition_size=int(d.get("zero_hpz_partition_size", 1)),
+            mics_shard_size=int(d.get("mics_shard_size", -1)),
             overlap_comm=bool(d.get("overlap_comm", True)),
             contiguous_gradients=bool(d.get("contiguous_gradients", True)),
             reduce_bucket_size=int(d.get("reduce_bucket_size", 5 * 10**8)),
@@ -176,7 +178,8 @@ class ParallelismConfig:
     sp: int = 1
 
     @classmethod
-    def from_config_dict(cls, d: Dict[str, Any], zero_stage: int) -> "ParallelismConfig":
+    def from_config_dict(cls, d: Dict[str, Any], zero_stage: int,
+                         mics_shard_size: int = -1) -> "ParallelismConfig":
         p = _sub(d, C.PARALLELISM)
         tp = int(p.get("tp", _sub(d, C.TENSOR_PARALLEL).get("tp_size", 1)))
         pp = int(p.get("pp", _sub(d, C.PIPELINE).get("stages", 1)))
@@ -184,7 +187,18 @@ class ParallelismConfig:
         sp = int(p.get("sp", d.get(C.SEQUENCE_PARALLEL_SIZE, 1)))
         fsdp = int(p.get("fsdp", 0)) or 0
         dp = int(p.get("dp", 0)) or 0
-        if not fsdp and not dp:
+        if mics_shard_size and mics_shard_size > 0:
+            # MiCS (reference runtime/zero/mics.py MiCS_Init): ZeRO shard
+            # groups smaller than the world — partition within an fsdp axis
+            # of exactly the shard-group size, replicate across the data
+            # axis. The reference's hierarchical allgather falls out of the
+            # axis order (fsdp is ICI-inner; data crosses the slower tier).
+            if fsdp and fsdp != mics_shard_size:
+                raise ValueError(
+                    f"mics_shard_size {mics_shard_size} conflicts with "
+                    f"explicit fsdp={fsdp}")
+            fsdp, dp = mics_shard_size, (dp or -1)
+        elif not fsdp and not dp:
             # ZeRO>=1 shards over fsdp: default puts all data-parallel replicas on
             # the fsdp axis; plain DP keeps them on data.
             if zero_stage >= 1:
@@ -430,7 +444,8 @@ class DSTpuConfig:
             fp16=fp16,
             bf16=bf16,
             zero=zero,
-            parallelism=ParallelismConfig.from_config_dict(d, zero.stage),
+            parallelism=ParallelismConfig.from_config_dict(
+                d, zero.stage, zero.mics_shard_size),
             activation_checkpointing=ActivationCheckpointingConfig.from_dict(
                 _sub(d, C.ACTIVATION_CHECKPOINTING)),
             monitor=MonitorConfig.from_config_dict(d),

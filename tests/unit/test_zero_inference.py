@@ -1,0 +1,146 @@
+"""ZeRO-Inference + elastic agent tests (reference analogs:
+``tests/unit/inference/quantization``, ``tests/unit/elasticity``)."""
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeedsyclsupport_tpu.compression.quantize import (QuantTensor,
+                                                           dequantize_tree,
+                                                           quantize_leaf,
+                                                           quantize_tree)
+from deepspeedsyclsupport_tpu.models import build_model
+
+
+class TestQuantTensor:
+    def test_roundtrip_error_bounded(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (64, 128))
+        qt = quantize_leaf(x, group_size=64)
+        back = qt.dequantize(jnp.float32)
+        # symmetric int8 with per-64 blocks: error << per-block max/127
+        err = np.abs(np.asarray(back) - np.asarray(x)).max()
+        assert err < float(np.abs(np.asarray(x)).max()) / 100
+
+    def test_scan_slices_quant_leaves(self):
+        """Stacked quantized leaves must thread through lax.scan (the
+        per-layer dequant property ZeRO-Inference rests on)."""
+        stacked = quantize_leaf(
+            jax.random.normal(jax.random.PRNGKey(1), (4, 8, 64)), 64)
+
+        def body(carry, qt):
+            assert isinstance(qt, QuantTensor)
+            return carry + qt.dequantize(jnp.float32).sum(), None
+
+        total, _ = jax.lax.scan(body, jnp.float32(0), stacked)
+        want = stacked.dequantize(jnp.float32).sum()
+        np.testing.assert_allclose(float(total), float(want), rtol=1e-5)
+
+    def test_quantize_tree_skips_small_leaves(self):
+        tree = {"big": jnp.ones((128, 128)), "small": jnp.ones((16,)),
+                "ints": jnp.ones((9000,), jnp.int32)}
+        out = quantize_tree(tree, 64, min_size=4096)
+        assert isinstance(out["big"], QuantTensor)
+        assert not isinstance(out["small"], QuantTensor)
+        assert not isinstance(out["ints"], QuantTensor)
+        deq = dequantize_tree(out)
+        assert deq["big"].shape == (128, 128)
+
+
+class TestZeroInferenceServing:
+    def test_v1_quantized_serving(self):
+        from deepspeedsyclsupport_tpu.inference import init_inference
+
+        model = build_model("tiny", dtype="float32")
+        params = model.init_params()
+        fp = init_inference(model=model, params=params, dtype="float32",
+                            max_seq_len=64)
+        q = init_inference(model=model, params=params, dtype="float32",
+                           max_seq_len=64,
+                           quant={"enabled": True, "group_size": 64,
+                                  "min_size": 512})
+        # memory: quantized layer weights are ~4x smaller
+        nbytes = lambda t: sum(np.asarray(x).nbytes
+                               for x in jax.tree_util.tree_leaves(t))
+        assert nbytes(q.params["layers"]) < nbytes(fp.params["layers"]) / 2.5
+        prompt = jnp.asarray([[3, 17, 88, 5]], jnp.int32)
+        logits_fp = np.asarray(fp(prompt))
+        logits_q = np.asarray(q(prompt))
+        # int8 weights: logits close, top-1 of the last position agrees
+        assert np.argmax(logits_q[0, -1]) == np.argmax(logits_fp[0, -1])
+        toks = q.generate(prompt, max_new_tokens=4)
+        assert np.asarray(toks).shape == (1, 4)
+
+    def test_v2_quantized_serving(self):
+        from deepspeedsyclsupport_tpu.inference.v2 import InferenceEngineV2
+
+        model = build_model("tiny", dtype="float32")
+        params = model.init_params()
+        eng = InferenceEngineV2(model, params, dtype=jnp.float32,
+                                block_size=8, max_context=64,
+                                max_tokens_per_batch=16, max_sequences=4,
+                                quantize_weights=True, quant_group_size=64)
+        out = eng.put([1], [[1, 5, 9, 200, 3]])
+        assert 1 in out and np.isfinite(out[1]).all()
+
+    def test_quant_rejects_tp(self):
+        from deepspeedsyclsupport_tpu.inference import init_inference
+
+        model = build_model("tiny", dtype="float32")
+        with pytest.raises(ValueError, match="tensor_parallel"):
+            init_inference(model=model, params=model.init_params(),
+                           dtype="float32", tensor_parallel={"tp_size": 2},
+                           quant=True)
+
+
+class TestElasticAgent:
+    def _worker(self, tmp_path, fail_times):
+        script = tmp_path / "worker.py"
+        script.write_text(f"""
+import os, sys
+marker = {str(tmp_path / 'attempts')!r}
+n = int(open(marker).read()) if os.path.exists(marker) else 0
+open(marker, "w").write(str(n + 1))
+assert os.environ.get("DSTPU_ELASTIC_RESTART_COUNT") == str(n)
+assert os.environ.get("DSTPU_ELASTIC_MICRO_BATCH")  # batch config exported
+sys.exit(1 if n < {fail_times} else 0)
+""")
+        return script
+
+    def _config(self):
+        return {"elasticity": {"enabled": True,
+                               "max_train_batch_size": 64,
+                               "micro_batch_sizes": [2, 4, 8],
+                               "min_gpus": 1, "max_gpus": 64}}
+
+    def test_restarts_until_success(self, tmp_path):
+        from deepspeedsyclsupport_tpu.elasticity import DSElasticAgent
+
+        script = self._worker(tmp_path, fail_times=2)
+        env = dict(WORLD_SIZE="8")
+        agent = DSElasticAgent([sys.executable, str(script)], self._config(),
+                               restart_limit=3, env=env)
+        os.environ["WORLD_SIZE"] = "8"
+        try:
+            rc = agent.run()
+        finally:
+            del os.environ["WORLD_SIZE"]
+        assert rc == 0
+        assert agent.restart_count == 2
+        assert [h["rc"] for h in agent.launch_history] == [1, 1, 0]
+
+    def test_restart_limit_exhausted(self, tmp_path):
+        from deepspeedsyclsupport_tpu.elasticity import DSElasticAgent
+
+        script = self._worker(tmp_path, fail_times=99)
+        os.environ["WORLD_SIZE"] = "8"
+        try:
+            agent = DSElasticAgent([sys.executable, str(script)],
+                                   self._config(), restart_limit=1)
+            rc = agent.run()
+        finally:
+            del os.environ["WORLD_SIZE"]
+        assert rc != 0
+        assert len(agent.launch_history) == 2  # initial + one restart
