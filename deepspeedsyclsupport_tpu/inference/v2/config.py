@@ -24,8 +24,13 @@ class RaggedInferenceConfig:
     seed: int = 0
     quantize_weights: bool = False   # ZeRO-Inference int8 layer weights
     quant_group_size: int = 64
+    prefill_attn: str = "auto"       # auto | flash | xla (mixed-batch path)
 
     def __post_init__(self):
+        if self.prefill_attn not in ("auto", "flash", "xla"):
+            raise ValueError(
+                f"prefill_attn must be auto|flash|xla, got "
+                f"{self.prefill_attn!r}")
         if self.num_blocks is None:
             per_seq = math.ceil(self.max_context / self.block_size)
             self.num_blocks = max(per_seq, self.max_sequences * per_seq // 2)

@@ -56,7 +56,8 @@ class InferenceEngineV2:
         self.kv = init_blocked_kv(model.config, cfg)
         self.allocator = BlockedAllocator(cfg.num_blocks)
         self.seqs: Dict[int, SequenceDescriptor] = {}
-        self._forward = build_ragged_forward_fn(model, cfg.block_size)
+        self._forward = build_ragged_forward_fn(model, cfg.block_size,
+                                                attn_impl=cfg.prefill_attn)
         self._decode_forward = None  # built lazily (kernel path)
         self._rng = jax.random.PRNGKey(cfg.seed)
         self._sample_fn = jax.jit(sample_token, static_argnums=(2,))

@@ -87,8 +87,47 @@ def _paged_attention(q, k_cache, v_cache, token_seq, token_pos, block_tables,
     return out.astype(q.dtype)
 
 
+def _packed_flash_attention(q, k_cache, v_cache, token_seq, token_pos,
+                            block_tables, block_size: int):
+    """Chunked-prefill attention through the Pallas flash kernel.
+
+    The fix for the O(T·max_ctx) per-token KV gather of
+    :func:`_paged_attention`: KV is gathered once per SEQUENCE
+    ([S, max_ctx] resolved from the block table), flattened into one packed
+    stream with per-slot segment ids + positions, and the flat token
+    queries attend through ``flash_attention``'s ragged cross-attention
+    mode — per-sequence boundaries from q/kv segment ids, causality in
+    position space, logits streamed (never materialized). This is the
+    TTFT-critical path (reference ``blocked_flash`` over ragged atoms).
+    """
+    from ...ops.flash_attention import flash_attention
+
+    t, h, d = q.shape
+    s, bps = block_tables.shape
+    bs = block_size
+    max_ctx = bps * bs
+
+    j = jnp.arange(max_ctx)
+    slot_of_pos = block_tables[:, j // bs] * bs + (j % bs)
+    k_seq = k_cache[slot_of_pos]  # [S, max_ctx, KVH, D] — once per sequence
+    v_seq = v_cache[slot_of_pos]
+    kvh = k_cache.shape[1]
+    k_flat = k_seq.reshape(1, s * max_ctx, kvh, d)
+    v_flat = v_seq.reshape(1, s * max_ctx, kvh, d)
+    kv_seg = jnp.repeat(jnp.arange(s, dtype=jnp.int32), max_ctx)[None]
+    kv_pos = jnp.tile(jnp.arange(max_ctx, dtype=jnp.int32), s)[None]
+    # pad tokens carry token_seq == S, matching no kv segment → fully masked
+    out = flash_attention(q[None], k_flat, v_flat, causal=True,
+                          segment_ids=token_seq[None].astype(jnp.int32),
+                          kv_segment_ids=kv_seg,
+                          q_positions=token_pos[None].astype(jnp.int32),
+                          kv_positions=kv_pos)
+    return out[0]
+
+
 def ragged_forward(model, params: Any, kv: BlockedKV, tokens, token_seq,
-                   token_pos, block_tables, last_tok_idx, *, block_size: int
+                   token_pos, block_tables, last_tok_idx, *, block_size: int,
+                   attn_impl: str = "auto"
                    ) -> Tuple[jnp.ndarray, BlockedKV]:
     """Flat-token forward. Returns (per-slot last-token logits [S, V], new kv).
 
@@ -126,8 +165,15 @@ def ragged_forward(model, params: Any, kv: BlockedKV, tokens, token_seq,
         k = apply_rope(k[None], token_pos[None], cfg.rope_theta)[0]
         k_cache = k_cache.at[dest].set(k.astype(k_cache.dtype), mode="drop")
         v_cache = v_cache.at[dest].set(v.astype(v_cache.dtype), mode="drop")
-        attn = _paged_attention(q, k_cache, v_cache, token_seq, token_pos,
-                                block_tables, bs)
+        impl = attn_impl
+        if impl == "auto":
+            impl = ("flash" if jax.default_backend() == "tpu" else "xla")
+        if impl == "flash":
+            attn = _packed_flash_attention(q, k_cache, v_cache, token_seq,
+                                           token_pos, block_tables, bs)
+        else:
+            attn = _paged_attention(q, k_cache, v_cache, token_seq,
+                                    token_pos, block_tables, bs)
         x = (x + jnp.einsum("tq,qd->td", attn.reshape(t, cfg.q_dim),
                             p["attn"]["wo"])).astype(x.dtype)
         y2 = rms_norm(x, p["mlp_norm"]["scale"], cfg.rms_norm_eps)
@@ -147,9 +193,10 @@ def ragged_forward(model, params: Any, kv: BlockedKV, tokens, token_seq,
     return logits.astype(jnp.float32), BlockedKV(nk, nv)
 
 
-def build_ragged_forward_fn(model, block_size: int):
+def build_ragged_forward_fn(model, block_size: int, attn_impl: str = "auto"):
     """Jitted, shape-stable forward (compiled once per engine)."""
-    fn = partial(ragged_forward, model, block_size=block_size)
+    fn = partial(ragged_forward, model, block_size=block_size,
+                 attn_impl=attn_impl)
     return jax.jit(fn, donate_argnums=(1,))
 
 

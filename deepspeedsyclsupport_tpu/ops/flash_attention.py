@@ -19,8 +19,13 @@ reference's fused-attention native code (v1 inference fused softmax/attention
 
 Masking supports causal (with Sq != Skv offsets), packed-sequence
 ``segment_ids``, and length padding (sequences pad to block multiples, the
-pad region is masked). Off-TPU the kernels run in interpret mode, which is
-also how the parity tests exercise them (SURVEY.md §4 pattern).
+pad region is masked). Causality compares explicit POSITION arrays, so the
+ragged packed-KV prefill path (``inference/v2/model.py``) can run many
+variable-context sequences in one call: q tokens carry their position within
+their own sequence, the packed KV carries per-slot positions, and separate
+q/kv segment ids bound each sequence. Off-TPU the kernels run in interpret
+mode, which is also how the parity tests exercise them (SURVEY.md §4
+pattern).
 """
 import functools
 from typing import Optional
@@ -41,25 +46,47 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-def _mask(i, j, seg_q, seg_k, *, causal, offset, q_len, kv_len,
+def _mask(i, j, seg_q, seg_k, pos_q, pos_k, *, causal, q_len, kv_len,
           block_q, block_k):
-    """[block_q, block_k] validity mask for tile (i, j)."""
-    q_pos = i * block_q + jax.lax.broadcasted_iota(
+    """[block_q, block_k] validity mask for tile (i, j).
+
+    Causality compares explicit POSITION values (``pos_q``/``pos_k`` blocks)
+    rather than array indices — for plain attention the positions are just
+    (offset-shifted) iotas, and for the ragged packed-KV prefill path they
+    are each token's position within its own sequence.
+    """
+    q_idx = i * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
-    k_pos = j * block_k + jax.lax.broadcasted_iota(
+    k_idx = j * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
-    m = jnp.logical_and(q_pos < q_len, k_pos < kv_len)
+    m = jnp.logical_and(q_idx < q_len, k_idx < kv_len)
     if causal:
-        m = jnp.logical_and(m, k_pos <= q_pos + offset)
+        m = jnp.logical_and(m, pos_k <= pos_q)  # (1,bk) vs (bq,1) broadcast
     m = jnp.logical_and(m, seg_q == seg_k)  # (bq,1) vs (1,bk) broadcast
     return m
 
 
+
+
+def _tile_live(seg_q, seg_k, pos_q, pos_k, causal):
+    """Dynamic tile skip: a (q-block, kv-block) tile is dead when no q/kv
+    segment pair can match, or (position-causal) when every kv position in
+    the block exceeds every q position. Pallas DMAs the blocks regardless,
+    but the three matmuls — the MXU cost — are skipped, which is what keeps
+    the packed ragged-prefill path O(tokens x own-context) in compute even
+    though the kv stream is the whole packed pool."""
+    live = jnp.logical_and(jnp.min(seg_k) <= jnp.max(seg_q),
+                           jnp.max(seg_k) >= jnp.min(seg_q))
+    if causal:
+        live = jnp.logical_and(live, jnp.min(pos_k) <= jnp.max(pos_q))
+    return live
+
+
 # ------------------------------------------------------------------- forward
-def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref,   # inputs
+def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, pq_ref, pk_ref,  # inputs
                 o_ref, lse_ref,                        # outputs
                 m_scr, l_scr, acc_scr,                 # scratch
-                *, scale, causal, offset, q_len, kv_len,
+                *, scale, causal, skip_offset, q_len, kv_len,
                 block_q, block_k, num_kv_blocks):
     i = pl.program_id(2)
     j = pl.program_id(3)
@@ -75,9 +102,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref,   # inputs
         k = k_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        mask = _mask(i, j, sq_ref[0], sk_ref[0], causal=causal, offset=offset,
-                     q_len=q_len, kv_len=kv_len, block_q=block_q,
-                     block_k=block_k)
+        mask = _mask(i, j, sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0],
+                     causal=causal, q_len=q_len, kv_len=kv_len,
+                     block_q=block_q, block_k=block_k)
         s = jnp.where(mask, s, NEG_INF)
         m_prev, l_prev = m_scr[...], l_scr[...]
         m_cur = jnp.max(s, axis=1, keepdims=True)          # [bq, 1]
@@ -93,13 +120,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref,   # inputs
                                  preferred_element_type=jnp.float32)
         acc_scr[...] = acc_scr[...] * alpha[:, :1] + pv
 
-    if causal:
-        # tiles strictly above the shifted diagonal contribute nothing
-        @pl.when((i + 1) * block_q - 1 + offset >= j * block_k)
+    live = _tile_live(sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0], causal)
+    if skip_offset is not None:
+        # default-position causal: tiles strictly above the shifted diagonal
+        # contribute nothing (custom positions rely on the dynamic skip)
+        @pl.when(jnp.logical_and(
+            (i + 1) * block_q - 1 + skip_offset >= j * block_k, live))
         def _():
             compute()
     else:
-        compute()
+        @pl.when(live)
+        def _():
+            compute()
 
     @pl.when(j == num_kv_blocks - 1)
     def _():
@@ -110,8 +142,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref,   # inputs
 
 # ------------------------------------------------------------------ backward
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
+               pq_ref, pk_ref,
                dq_ref, dq_scr,
-               *, scale, causal, offset, q_len, kv_len,
+               *, scale, causal, skip_offset, q_len, kv_len,
                block_q, block_k, num_kv_blocks):
     i = pl.program_id(2)
     j = pl.program_id(3)
@@ -127,9 +160,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
         do = do_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        mask = _mask(i, j, sq_ref[0], sk_ref[0], causal=causal, offset=offset,
-                     q_len=q_len, kv_len=kv_len, block_q=block_q,
-                     block_k=block_k)
+        mask = _mask(i, j, sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0],
+                     causal=causal, q_len=q_len, kv_len=kv_len,
+                     block_q=block_q, block_k=block_k)
         p = jnp.where(mask, jnp.exp(s - lse_ref[0, 0]), 0.0)   # [bq, bk]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -138,12 +171,16 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        @pl.when((i + 1) * block_q - 1 + offset >= j * block_k)
+    live = _tile_live(sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0], causal)
+    if skip_offset is not None:
+        @pl.when(jnp.logical_and(
+            (i + 1) * block_q - 1 + skip_offset >= j * block_k, live))
         def _():
             compute()
     else:
-        compute()
+        @pl.when(live)
+        def _():
+            compute()
 
     @pl.when(j == num_kv_blocks - 1)
     def _():
@@ -151,8 +188,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
+                pq_ref, pk_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
-                *, scale, causal, offset, q_len, kv_len,
+                *, scale, causal, skip_offset, q_len, kv_len,
                 block_q, block_k, num_q_blocks):
     j = pl.program_id(2)   # kv block (outer)
     i = pl.program_id(3)   # q block (inner, sequential accumulation)
@@ -169,9 +207,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
         do = do_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        mask = _mask(i, j, sq_ref[0], sk_ref[0], causal=causal, offset=offset,
-                     q_len=q_len, kv_len=kv_len, block_q=block_q,
-                     block_k=block_k)
+        mask = _mask(i, j, sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0],
+                     causal=causal, q_len=q_len, kv_len=kv_len,
+                     block_q=block_q, block_k=block_k)
         p = jnp.where(mask, jnp.exp(s - lse_ref[0, 0]), 0.0)   # [bq, bk]
         dv_scr[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -183,12 +221,16 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                 # [bk, D]
 
-    if causal:
-        @pl.when((i + 1) * block_q - 1 + offset >= j * block_k)
+    live = _tile_live(sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0], causal)
+    if skip_offset is not None:
+        @pl.when(jnp.logical_and(
+            (i + 1) * block_q - 1 + skip_offset >= j * block_k, live))
         def _():
             compute()
     else:
-        compute()
+        @pl.when(live)
+        def _():
+            compute()
 
     @pl.when(i == num_q_blocks - 1)
     def _():
@@ -197,16 +239,16 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
 
 
 # ------------------------------------------------------------- pallas_call’s
-def _fwd_call(q, k, v, seg_q, seg_k, *, scale, causal, offset, q_len, kv_len,
-              block_q, block_k, interpret):
+def _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, *, scale, causal,
+              skip_offset, q_len, kv_len, block_q, block_k, interpret):
     b, h, sq, d = q.shape
     kvh = k.shape[1]
     skv = k.shape[2]
     grid = (b, h, sq // block_q, skv // block_k)
     g = h // kvh
     kern = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal,
-        offset=offset, q_len=q_len, kv_len=kv_len, block_q=block_q,
+        _fwd_kernel, scale=scale, causal=causal, skip_offset=skip_offset,
+        q_len=q_len, kv_len=kv_len, block_q=block_q,
         block_k=block_k, num_kv_blocks=grid[3])
     return pl.pallas_call(
         kern,
@@ -217,6 +259,8 @@ def _fwd_call(q, k, v, seg_q, seg_k, *, scale, causal, offset, q_len, kv_len,
                          lambda b, h, i, j: (b, h // g, j, 0)),
             pl.BlockSpec((1, 1, block_k, d),
                          lambda b, h, i, j: (b, h // g, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, h, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, h, i, j: (b, 0, j)),
             pl.BlockSpec((1, block_q, 1), lambda b, h, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, block_k), lambda b, h, i, j: (b, 0, j)),
         ],
@@ -237,19 +281,21 @@ def _fwd_call(q, k, v, seg_q, seg_k, *, scale, causal, offset, q_len, kv_len,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v, seg_q, seg_k)
+    )(q, k, v, seg_q, seg_k, pos_q, pos_k)
 
 
-def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, *, scale, causal, offset,
-              q_len, kv_len, block_q, block_k, interpret):
+def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, *, scale,
+              causal, skip_offset, q_len, kv_len, block_q, block_k,
+              interpret):
     b, h, sq, d = q.shape
     kvh = k.shape[1]
     skv = k.shape[2]
     g = h // kvh
 
     nq, nkv = sq // block_q, skv // block_k
-    common = dict(scale=scale, causal=causal, offset=offset, q_len=q_len,
-                  kv_len=kv_len, block_q=block_q, block_k=block_k)
+    common = dict(scale=scale, causal=causal, skip_offset=skip_offset,
+                  q_len=q_len, kv_len=kv_len, block_q=block_q,
+                  block_k=block_k)
     q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0))
     kv_spec = pl.BlockSpec((1, 1, block_k, d),
                            lambda b, h, i, j: (b, h // g, j, 0))
@@ -261,7 +307,7 @@ def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, *, scale, causal, offset,
         functools.partial(_dq_kernel, num_kv_blocks=nkv, **common),
         grid=(b, h, nq, nkv),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec,
-                  sq_spec, sk_spec],
+                  sq_spec, sk_spec, sq_spec, sk_spec],
         out_specs=pl.BlockSpec((1, 1, block_q, d),
                                lambda b, h, i, j: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, sq, d), jnp.float32),
@@ -270,7 +316,7 @@ def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, *, scale, causal, offset,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse, delta, seg_q, seg_k)
+    )(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k)
 
     # grid reordered: kv block outer, q block inner (sequential accumulation)
     q_spec2 = pl.BlockSpec((1, 1, block_q, d), lambda b, h, j, i: (b, h, i, 0))
@@ -286,7 +332,7 @@ def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, *, scale, causal, offset,
         functools.partial(_dkv_kernel, num_q_blocks=nq, **common),
         grid=(b, h, nkv, nq),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2,
-                  sq_spec2, sk_spec2],
+                  sq_spec2, sk_spec2, sq_spec2, sk_spec2],
         out_specs=[dkv_out, dkv_out],
         out_shape=[jax.ShapeDtypeStruct((b, h, skv, d), jnp.float32),
                    jax.ShapeDtypeStruct((b, h, skv, d), jnp.float32)],
@@ -296,7 +342,7 @@ def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, *, scale, causal, offset,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse, delta, seg_q, seg_k)
+    )(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k)
     if g > 1:
         dk = dk.reshape(b, kvh, g, skv, d).sum(axis=2)
         dv = dv.reshape(b, kvh, g, skv, d).sum(axis=2)
@@ -305,30 +351,30 @@ def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, *, scale, causal, offset,
 
 # ----------------------------------------------------------------- custom_vjp
 @functools.lru_cache(maxsize=None)
-def _make_flash(head_dim, causal, offset, q_len, kv_len, block_q, block_k,
-                interpret):
+def _make_flash(head_dim, causal, skip_offset, q_len, kv_len, block_q,
+                block_k, interpret):
     call_kw = dict(scale=1.0 / np.sqrt(head_dim), causal=causal,
-                   offset=offset, q_len=q_len, kv_len=kv_len,
+                   skip_offset=skip_offset, q_len=q_len, kv_len=kv_len,
                    block_q=block_q, block_k=block_k, interpret=interpret)
 
     @jax.custom_vjp
-    def f(q, k, v, seg_q, seg_k):
-        o, _ = _fwd_call(q, k, v, seg_q, seg_k, **call_kw)
+    def f(q, k, v, seg_q, seg_k, pos_q, pos_k):
+        o, _ = _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, **call_kw)
         return o
 
-    def f_fwd(q, k, v, seg_q, seg_k):
-        o, lse = _fwd_call(q, k, v, seg_q, seg_k, **call_kw)
-        return o, (q, k, v, seg_q, seg_k, o, lse)
+    def f_fwd(q, k, v, seg_q, seg_k, pos_q, pos_k):
+        o, lse = _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, **call_kw)
+        return o, (q, k, v, seg_q, seg_k, pos_q, pos_k, o, lse)
 
     def f_bwd(res, do):
-        q, k, v, seg_q, seg_k, o, lse = res
+        q, k, v, seg_q, seg_k, pos_q, pos_k, o, lse = res
         delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                         axis=-1, keepdims=True)            # [B,H,Sq,1]
         dq, dk, dv = _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k,
-                               **call_kw)
+                               pos_q, pos_k, **call_kw)
         zero = lambda x: np.zeros(x.shape, jax.dtypes.float0)
         return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
-                zero(seg_q), zero(seg_k))
+                zero(seg_q), zero(seg_k), zero(pos_q), zero(pos_k))
 
     f.defvjp(f_fwd, f_bwd)
     return f
@@ -338,13 +384,20 @@ def _make_flash(head_dim, causal, offset, q_len, kv_len, block_q, block_k,
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True,
                     segment_ids: Optional[jnp.ndarray] = None,
+                    kv_segment_ids: Optional[jnp.ndarray] = None,
+                    q_positions: Optional[jnp.ndarray] = None,
+                    kv_positions: Optional[jnp.ndarray] = None,
                     block_q: int = 512, block_k: int = 512,
                     interpret: Optional[bool] = None) -> jnp.ndarray:
     """Flash attention over ``q [B,Sq,H,D]``, ``k/v [B,Skv,KVH,D]``.
 
     Differentiable (custom fwd/bwd Pallas kernels); GQA when ``KVH < H``;
-    ``segment_ids [B,S]`` masks attention across packed-sequence boundaries.
-    Returns ``[B,Sq,H,D]`` in q's dtype. Off-TPU runs in interpret mode.
+    ``segment_ids [B,Sq]`` masks attention across packed-sequence
+    boundaries. For ragged cross-attention (the v2 packed-KV prefill path)
+    pass ``kv_segment_ids [B,Skv]`` plus explicit ``q_positions [B,Sq]`` /
+    ``kv_positions [B,Skv]`` — causality then compares in-sequence
+    positions instead of array indices. Returns ``[B,Sq,H,D]`` in q's
+    dtype. Off-TPU runs in interpret mode.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -353,6 +406,9 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if h % kvh:
         raise ValueError(f"q heads {h} not a multiple of kv heads {kvh}")
     offset = skv - sq
+    custom_pos = q_positions is not None or kv_positions is not None
+    # the static diagonal tile-skip is only sound for default positions
+    skip_offset = offset if (causal and not custom_pos) else None
 
     # block sizes clamp to the (padded) sequence
     block_q = min(block_q, _round_up(sq, 128))
@@ -370,19 +426,45 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     kt = pad(jnp.transpose(k, (0, 2, 1, 3)), skv_p, 2)    # [B,KVH,Skv,D]
     vt = pad(jnp.transpose(v, (0, 2, 1, 3)), skv_p, 2)
 
-    if segment_ids is None:
+    if segment_ids is None and kv_segment_ids is None:
         seg_q = jnp.zeros((b, sq_p, 1), jnp.int32)
         seg_k = jnp.zeros((b, 1, skv_p), jnp.int32)
     else:
-        if segment_ids.shape[1] == sq == skv:
+        if kv_segment_ids is not None:
+            if segment_ids is None or segment_ids.shape[1] != sq or \
+                    kv_segment_ids.shape[1] != skv:
+                raise ValueError("kv_segment_ids needs segment_ids [B,Sq] "
+                                 "and kv_segment_ids [B,Skv]")
+            sq_ids = segment_ids.astype(jnp.int32)
+            sk_ids = kv_segment_ids.astype(jnp.int32)
+        elif segment_ids.shape[1] == sq == skv:
             sq_ids = sk_ids = segment_ids.astype(jnp.int32)
         else:
             raise ValueError("segment_ids requires Sq == Skv == ids length")
-        seg_q = jnp.pad(sq_ids, ((0, 0), (0, sq_p - sq)))[:, :, None]
-        seg_k = jnp.pad(sk_ids, ((0, 0), (0, skv_p - skv)))[:, None, :]
+        # pad kv segments with -1 so pad slots match no real segment
+        seg_q = jnp.pad(sq_ids, ((0, 0), (0, sq_p - sq)),
+                        constant_values=-2)[:, :, None]
+        seg_k = jnp.pad(sk_ids, ((0, 0), (0, skv_p - skv)),
+                        constant_values=-1)[:, None, :]
 
-    fn = _make_flash(int(d), bool(causal), int(offset), int(sq), int(skv),
-                     int(block_q), int(block_k), bool(interpret))
-    out = fn(qt, kt, vt, seg_q, seg_k)                    # [B,H,Sq_p,D_p]
+    if q_positions is None:
+        q_pos = jnp.broadcast_to(jnp.arange(sq, dtype=jnp.int32) + offset,
+                                 (b, sq))
+    else:
+        q_pos = q_positions.astype(jnp.int32)
+    if kv_positions is None:
+        kv_pos = jnp.broadcast_to(jnp.arange(skv, dtype=jnp.int32), (b, skv))
+    else:
+        kv_pos = kv_positions.astype(jnp.int32)
+    # pad kv positions huge so a pad slot is never <= any real q position
+    pos_q = jnp.pad(q_pos, ((0, 0), (0, sq_p - sq)))[:, :, None]
+    pos_k = jnp.pad(kv_pos, ((0, 0), (0, skv_p - skv)),
+                    constant_values=2**30)[:, None, :]
+
+    fn = _make_flash(int(d), bool(causal),
+                     None if skip_offset is None else int(skip_offset),
+                     int(sq), int(skv), int(block_q), int(block_k),
+                     bool(interpret))
+    out = fn(qt, kt, vt, seg_q, seg_k, pos_q, pos_k)      # [B,H,Sq_p,D_p]
     out = out[:, :, :sq, :d]
     return jnp.transpose(out, (0, 2, 1, 3))
