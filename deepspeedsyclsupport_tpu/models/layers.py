@@ -124,6 +124,34 @@ def reference_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return out.astype(q.dtype)
 
 
+def _cached_flash_attention(q, k, v, causal, kv_positions_below, kv_mask,
+                            interpret=None):
+    """KV-cache attention through the flash kernel (the v1 engine's prefill
+    and decode steps). Slot-space masks map onto the kernel's ragged mode:
+    ``kv_positions_below`` becomes explicit q positions (query i sees slots
+    < below[i] ⇔ slot index <= below[i]-1; kv positions default to slot
+    indices), and ``kv_mask`` becomes a kv segment id (-1 = invalid slot,
+    matching no query). ``segment_ids`` are deliberately NOT consumed here,
+    matching :func:`reference_attention`, which ignores them whenever
+    Sq != Skv (the cached case)."""
+    from ..ops.flash_attention import flash_attention
+
+    b, sq = q.shape[:2]
+    skv = k.shape[1]
+    q_pos = None
+    use_causal = causal
+    if kv_positions_below is not None:
+        q_pos = kv_positions_below.astype(jnp.int32) - 1     # [B, Sq]
+        use_causal = True
+    seg_q = seg_k = None
+    if kv_mask is not None:
+        seg_q = jnp.zeros((b, sq), jnp.int32)
+        seg_k = jnp.where(kv_mask, 0, -1).astype(jnp.int32)
+    return flash_attention(q, k, v, causal=use_causal,
+                           segment_ids=seg_q, kv_segment_ids=seg_k,
+                           q_positions=q_pos, interpret=interpret)
+
+
 def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
               impl: str = "auto",
               causal: bool = True,
@@ -133,13 +161,16 @@ def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """Attention dispatch — the seam where Pallas/SP implementations plug in
     (reference analog: the op-binding indirection of
     ``ops/transformer/inference/op_binding/``)."""
-    if kv_positions_below is not None or kv_mask is not None:
-        # cached-decode masking: only the xla reference implements slot-space
-        # masks. flash/ring/ulysses are training/prefill patterns — routing
-        # them here would silently drop the mask and attend to garbage slots.
-        impl = "xla"
     if impl == "auto":
         impl = "flash" if jax.default_backend() == "tpu" else "xla"
+    if kv_positions_below is not None or kv_mask is not None:
+        # cached-decode masking (slot-space causality + slot validity). The
+        # flash kernel handles it via explicit position arrays + kv segment
+        # ids; ring/ulysses are training patterns and fall back to xla.
+        if impl == "flash":
+            return _cached_flash_attention(q, k, v, causal,
+                                           kv_positions_below, kv_mask)
+        impl = "xla"
     if impl == "flash":
         from ..ops.flash_attention import flash_attention
 

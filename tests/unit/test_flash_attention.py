@@ -134,3 +134,64 @@ class TestFlashGradParity:
                 reference_attention(q, k, v, causal=True) ** 2))(q, k, v)
         np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
                                    rtol=2e-4, atol=2e-4)
+
+
+class TestCachedDecodeFlash:
+    """KV-cache attention through the kernel (v1 prefill/decode): slot-space
+    masks mapped to position arrays + kv segment ids must match the exact
+    reference for chunked prefill and single-token decode."""
+
+    def _data(self, b=2, sq=4, skv=32, h=4, kvh=2, d=16, seed=0):
+        rng = jax.random.PRNGKey(seed)
+        kq, kk, kv_ = jax.random.split(rng, 3)
+        q = jax.random.normal(kq, (b, sq, h, d), jnp.float32)
+        k = jax.random.normal(kk, (b, skv, kvh, d), jnp.float32)
+        v = jax.random.normal(kv_, (b, skv, kvh, d), jnp.float32)
+        return q, k, v
+
+    def test_positions_below_parity(self):
+        from deepspeedsyclsupport_tpu.models.layers import (
+            _cached_flash_attention, reference_attention)
+
+        q, k, v = self._data()
+        # chunk of 4 queries written at slots 10..13 → see slots <= own
+        kv_below = jnp.asarray([[11, 12, 13, 14], [11, 12, 13, 14]],
+                               jnp.int32)
+        want = reference_attention(q, k, v, causal=False,
+                                   kv_positions_below=kv_below)
+        got = _cached_flash_attention(q, k, v, False, kv_below, None,
+                                      interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_positions_below_with_kv_mask_parity(self):
+        from deepspeedsyclsupport_tpu.models.layers import (
+            _cached_flash_attention, reference_attention)
+
+        q, k, v = self._data()
+        kv_below = jnp.asarray([[21, 22, 23, 24], [21, 22, 23, 24]],
+                               jnp.int32)
+        # ragged right-padding: slots 5..9 of row 0 invalid
+        mask = np.ones((2, 32), bool)
+        mask[0, 5:10] = False
+        kv_mask = jnp.asarray(mask)
+        want = reference_attention(q, k, v, causal=False,
+                                   kv_positions_below=kv_below,
+                                   kv_mask=kv_mask)
+        got = _cached_flash_attention(q, k, v, False, kv_below,
+                                      kv_mask, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_single_token_decode_parity(self):
+        from deepspeedsyclsupport_tpu.models.layers import (
+            _cached_flash_attention, reference_attention)
+
+        q, k, v = self._data(sq=1)
+        kv_below = jnp.asarray([[17], [9]], jnp.int32)
+        want = reference_attention(q, k, v, causal=False,
+                                   kv_positions_below=kv_below)
+        got = _cached_flash_attention(q, k, v, False, kv_below, None,
+                                      interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
