@@ -241,8 +241,15 @@ class InferenceEngine:
             pos = (prompt_lens + t)[:, None]
             kv_mask = (slots[None, :] < prompt_lens[:, None]) | \
                       ((slots >= s) & (slots <= s + t))[None, :]
+            # true logical position of each cache slot (prompt slots sit at
+            # slot==position; decode slot s+j holds position prompt_len+j) —
+            # keeps causality/ALiBi/sliding-window in position space even
+            # though ragged padding makes slot != position
+            kv_pos = jnp.where(slots[None, :] < s, slots[None, :],
+                               prompt_lens[:, None] + (slots[None, :] - s))
             logits, cache = model.decode_step(params, cache, tok[:, None],
-                                              positions=pos, kv_mask=kv_mask)
+                                              positions=pos, kv_mask=kv_mask,
+                                              kv_positions=kv_pos)
             key, sub = jax.random.split(key)
             nxt = sample_token(logits[:, 0], sub, sp)
             if eos_id >= 0:

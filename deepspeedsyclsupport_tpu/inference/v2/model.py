@@ -18,6 +18,11 @@ routing — batch composition never changes the compiled program.
 
 Reuses the training model's parameters and sublayer math (``models/layers.py``)
 — the weight-sharing the reference needs separate inference containers for.
+The full architecture-config surface (layernorm/rmsnorm, rope/learned/alibi
+positions, partial rotary, gated/standard MLP, parallel residual blocks,
+biases, sliding window) serves here exactly as in training — the analog of the
+reference's v2 model zoo (``inference/v2/model_implementations/{llama_v2,
+mistral,mixtral,opt,falcon,phi}.py``) as config axes instead of classes.
 """
 from functools import partial
 from typing import Any, Tuple
@@ -27,7 +32,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .kv_cache import BlockedKV
-from ...models.layers import apply_rope, glu_mlp, rms_norm
+from ...models.layers import alibi_slopes, apply_rope, mlp_block, norm
+
+NEG_INF = jnp.finfo(jnp.float32).min
 
 
 def _dequant(p, dtype):
@@ -38,18 +45,88 @@ def _dequant(p, dtype):
 
 
 def _mlp(p, y, cfg):
-    """Per-layer MLP over flat tokens [T, D]: dense GLU, or exact top-k MoE
-    via grouped GEMMs (the moe_scatter/cutlass-multi-GEMM/moe_gather analog,
-    ``parallel/moe.moe_mlp_nodrop``)."""
+    """Per-layer MLP over flat tokens [T, D]: dense (GLU or fc1/fc2), or exact
+    top-k MoE via grouped GEMMs (the moe_scatter/cutlass-multi-GEMM/moe_gather
+    analog, ``parallel/moe.moe_mlp_nodrop``)."""
     if cfg.any_moe:
         from ...parallel.moe import moe_mlp_nodrop
 
         return moe_mlp_nodrop(p["moe"], y, cfg)
-    return glu_mlp(p["mlp"], y[None], cfg)[0]
+    return mlp_block(p["mlp"], y[None], cfg)[0]
+
+
+def _qkv(p, y, cfg, n):
+    """Fused qkv projection over flat tokens [n, D] (+ optional biases)."""
+    q = jnp.einsum("td,dq->tq", y, p["wq"])
+    k = jnp.einsum("td,dk->tk", y, p["wk"])
+    v = jnp.einsum("td,dk->tk", y, p["wv"])
+    if cfg.qkv_bias:
+        q = q + p["bq"].astype(q.dtype)
+        k = k + p["bk"].astype(k.dtype)
+        v = v + p["bv"].astype(v.dtype)
+    return (q.reshape(n, cfg.num_heads, cfg.head_dim),
+            k.reshape(n, cfg.num_kv_heads, cfg.head_dim),
+            v.reshape(n, cfg.num_kv_heads, cfg.head_dim))
+
+
+def _attn_out(p, attn, cfg, n):
+    out = jnp.einsum("tq,qd->td", attn.reshape(n, cfg.q_dim), p["wo"])
+    if cfg.attn_out_bias:
+        out = out + p["bo"].astype(out.dtype)
+    return out
+
+
+def _positionize(cfg, q, k, positions):
+    if cfg.pos_embed == "rope":
+        q = apply_rope(q[None], positions[None], cfg.rope_theta,
+                       cfg.rotary_dim)[0]
+        k = apply_rope(k[None], positions[None], cfg.rope_theta,
+                       cfg.rotary_dim)[0]
+    return q, k
+
+
+def _arch_bias(cfg):
+    ab = (jnp.asarray(alibi_slopes(cfg.num_heads))
+          if cfg.pos_embed == "alibi" else None)
+    return ab, cfg.sliding_window
+
+
+def _embed(params, tokens, positions, cfg):
+    x = jnp.take(params["embed"]["embedding"], tokens, axis=0)
+    if cfg.pos_embed == "learned":
+        table = params["pos_embed"]["embedding"]
+        pos = jnp.clip(positions + cfg.pos_embed_offset, 0,
+                       table.shape[0] - 1)
+        x = x + jnp.take(table, pos, axis=0).astype(x.dtype)
+    x = x.astype(jnp.dtype(cfg.dtype))
+    if cfg.embed_norm:
+        x = norm(x, params["embed_norm"], cfg)
+    return x
+
+
+def _unembed(params, x, cfg):
+    if cfg.tie_embeddings:
+        return jnp.einsum("sd,vd->sv", x,
+                          params["embed"]["embedding"].astype(x.dtype))
+    return jnp.einsum("sd,dv->sv", x,
+                      params["lm_head"]["kernel"].astype(x.dtype))
+
+
+def _block(cfg, p, x, attn_fn):
+    """One transformer block over flat tokens, covering sequential and
+    parallel (GPT-J/NeoX/Falcon/Phi) residual forms."""
+    x_norm = norm(x, p["attn_norm"], cfg)
+    attn = attn_fn(x_norm)
+    h = _attn_out(p["attn"], attn, cfg, x.shape[0])
+    if cfg.parallel_block:
+        y = x_norm if cfg.shared_block_norm else norm(x, p["mlp_norm"], cfg)
+        return (x + h + _mlp(p, y, cfg)).astype(x.dtype)
+    x = (x + h).astype(x.dtype)
+    return (x + _mlp(p, norm(x, p["mlp_norm"], cfg), cfg)).astype(x.dtype)
 
 
 def _paged_attention(q, k_cache, v_cache, token_seq, token_pos, block_tables,
-                     block_size: int):
+                     block_size: int, alibi=None, window=None):
     """q: [T, H, D]; caches: [num_slots, KVH, D] (flat slot axis);
     block_tables: [S, Bps]. Returns [T, H, D].
 
@@ -80,15 +157,22 @@ def _paged_attention(q, k_cache, v_cache, token_seq, token_pos, block_tables,
     scale = 1.0 / np.sqrt(d)
     logits = jnp.einsum("thd,tchd->thc", q.astype(jnp.float32),
                         k_tok.astype(jnp.float32)) * scale
+    if alibi is not None:
+        logits = logits + alibi.astype(jnp.float32)[None, :, None] * (
+            j[None, None, :] - token_pos[:, None, None]).astype(jnp.float32)
     mask = (j[None, :] <= token_pos[:, None])[:, None, :]  # causal over own seq
-    logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    if window is not None:
+        mask = jnp.logical_and(
+            mask, (token_pos[:, None] - j[None, :] < window)[:, None, :])
+    logits = jnp.where(mask, logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("thc,tchd->thd", probs, v_tok.astype(jnp.float32))
     return out.astype(q.dtype)
 
 
 def _packed_flash_attention(q, k_cache, v_cache, token_seq, token_pos,
-                            block_tables, block_size: int):
+                            block_tables, block_size: int, alibi=None,
+                            window=None):
     """Chunked-prefill attention through the Pallas flash kernel.
 
     The fix for the O(T·max_ctx) per-token KV gather of
@@ -121,7 +205,7 @@ def _packed_flash_attention(q, k_cache, v_cache, token_seq, token_pos,
                           segment_ids=token_seq[None].astype(jnp.int32),
                           kv_segment_ids=kv_seg,
                           q_positions=token_pos[None].astype(jnp.int32),
-                          kv_positions=kv_pos)
+                          kv_positions=kv_pos, alibi=alibi, window=window)
     return out[0]
 
 
@@ -140,6 +224,7 @@ def ragged_forward(model, params: Any, kv: BlockedKV, tokens, token_seq,
     num_slots = kv.num_slots
     t = tokens.shape[0]
     s = block_tables.shape[0]
+    ab, window = _arch_bias(cfg)
 
     pad = token_seq >= s  # padding sentinel from RaggedBatch
     # flat destination slot per token; padded tokens scatter out-of-range (drop)
@@ -147,49 +232,39 @@ def ragged_forward(model, params: Any, kv: BlockedKV, tokens, token_seq,
                               token_pos // bs]
     dest = jnp.where(pad, num_slots, dest_block * bs + token_pos % bs)
 
-    x = jnp.take(params["embed"]["embedding"], tokens, axis=0)
-    x = x.astype(jnp.dtype(cfg.dtype))
+    x = _embed(params, tokens, token_pos, cfg)
 
     def layer(x, inp):
         p, k_cache, v_cache = inp
         p = _dequant(p, x.dtype)
-        y = rms_norm(x, p["attn_norm"]["scale"], cfg.rms_norm_eps)
-        q = jnp.einsum("td,dq->tq", y, p["attn"]["wq"]).reshape(
-            t, cfg.num_heads, cfg.head_dim)
-        k = jnp.einsum("td,dk->tk", y, p["attn"]["wk"]).reshape(
-            t, cfg.num_kv_heads, cfg.head_dim)
-        v = jnp.einsum("td,dk->tk", y, p["attn"]["wv"]).reshape(
-            t, cfg.num_kv_heads, cfg.head_dim)
-        # RoPE in [B=1, S=T] layout
-        q = apply_rope(q[None], token_pos[None], cfg.rope_theta)[0]
-        k = apply_rope(k[None], token_pos[None], cfg.rope_theta)[0]
-        k_cache = k_cache.at[dest].set(k.astype(k_cache.dtype), mode="drop")
-        v_cache = v_cache.at[dest].set(v.astype(v_cache.dtype), mode="drop")
-        impl = attn_impl
-        if impl == "auto":
-            impl = ("flash" if jax.default_backend() == "tpu" else "xla")
-        if impl == "flash":
-            attn = _packed_flash_attention(q, k_cache, v_cache, token_seq,
-                                           token_pos, block_tables, bs)
-        else:
-            attn = _paged_attention(q, k_cache, v_cache, token_seq,
-                                    token_pos, block_tables, bs)
-        x = (x + jnp.einsum("tq,qd->td", attn.reshape(t, cfg.q_dim),
-                            p["attn"]["wo"])).astype(x.dtype)
-        y2 = rms_norm(x, p["mlp_norm"]["scale"], cfg.rms_norm_eps)
-        h = _mlp(p, y2, cfg)
-        return (x + h).astype(x.dtype), (k_cache, v_cache)
+
+        def attn_fn(y):
+            nonlocal k_cache, v_cache
+            q, k, v = _qkv(p["attn"], y, cfg, t)
+            q, k = _positionize(cfg, q, k, token_pos)
+            k_cache = k_cache.at[dest].set(k.astype(k_cache.dtype),
+                                           mode="drop")
+            v_cache = v_cache.at[dest].set(v.astype(v_cache.dtype),
+                                           mode="drop")
+            impl = attn_impl
+            if impl == "auto":
+                impl = ("flash" if jax.default_backend() == "tpu" else "xla")
+            if impl == "flash":
+                return _packed_flash_attention(q, k_cache, v_cache, token_seq,
+                                               token_pos, block_tables, bs,
+                                               alibi=ab, window=window)
+            return _paged_attention(q, k_cache, v_cache, token_seq,
+                                    token_pos, block_tables, bs,
+                                    alibi=ab, window=window)
+
+        x = _block(cfg, p, x, attn_fn)
+        return x, (k_cache, v_cache)
 
     x, (nk, nv) = jax.lax.scan(layer, x, (params["layers"], kv.k, kv.v))
 
-    x = rms_norm(x, params["final_norm"]["scale"], cfg.rms_norm_eps)
+    x = norm(x, params["final_norm"], cfg)
     h_last = x[last_tok_idx]  # [S, d] — logits_gather
-    if cfg.tie_embeddings:
-        logits = jnp.einsum("sd,vd->sv", h_last,
-                            params["embed"]["embedding"].astype(h_last.dtype))
-    else:
-        logits = jnp.einsum("sd,dv->sv", h_last,
-                            params["lm_head"]["kernel"].astype(h_last.dtype))
+    logits = _unembed(params, h_last, cfg)
     return logits.astype(jnp.float32), BlockedKV(nk, nv)
 
 
@@ -218,45 +293,38 @@ def decode_forward(model, params: Any, kv: BlockedKV, tokens, positions,
     bs = block_size
     num_slots = kv.num_slots
     s = tokens.shape[0]
+    ab, window = _arch_bias(cfg)
 
     dest_block = jnp.take_along_axis(
         block_tables, (positions // bs)[:, None], axis=1)[:, 0]
     dest = jnp.where(active, dest_block * bs + positions % bs, num_slots)
     seq_lens = jnp.where(active, positions + 1, 0)
 
-    x = jnp.take(params["embed"]["embedding"], tokens, axis=0)
-    x = x.astype(jnp.dtype(cfg.dtype))
+    x = _embed(params, tokens, positions, cfg)
 
     def layer(x, inp):
         p, k_cache, v_cache = inp
         p = _dequant(p, x.dtype)
-        y = rms_norm(x, p["attn_norm"]["scale"], cfg.rms_norm_eps)
-        q = jnp.einsum("sd,dq->sq", y, p["attn"]["wq"]).reshape(
-            s, cfg.num_heads, cfg.head_dim)
-        k = jnp.einsum("sd,dk->sk", y, p["attn"]["wk"]).reshape(
-            s, cfg.num_kv_heads, cfg.head_dim)
-        v = jnp.einsum("sd,dk->sk", y, p["attn"]["wv"]).reshape(
-            s, cfg.num_kv_heads, cfg.head_dim)
-        q = apply_rope(q[None], positions[None], cfg.rope_theta)[0]
-        k = apply_rope(k[None], positions[None], cfg.rope_theta)[0]
-        k_cache = k_cache.at[dest].set(k.astype(k_cache.dtype), mode="drop")
-        v_cache = v_cache.at[dest].set(v.astype(v_cache.dtype), mode="drop")
-        attn = paged_decode_attention(q, k_cache, v_cache, block_tables,
-                                      seq_lens, block_size=bs, impl=attn_impl)
-        x2 = (x + jnp.einsum("sq,qd->sd", attn.reshape(s, cfg.q_dim),
-                             p["attn"]["wo"])).astype(x.dtype)
-        y2 = rms_norm(x2, p["mlp_norm"]["scale"], cfg.rms_norm_eps)
-        h = _mlp(p, y2, cfg)
-        return (x2 + h).astype(x.dtype), (k_cache, v_cache)
+
+        def attn_fn(y):
+            nonlocal k_cache, v_cache
+            q, k, v = _qkv(p["attn"], y, cfg, s)
+            q, k = _positionize(cfg, q, k, positions)
+            k_cache = k_cache.at[dest].set(k.astype(k_cache.dtype),
+                                           mode="drop")
+            v_cache = v_cache.at[dest].set(v.astype(v_cache.dtype),
+                                           mode="drop")
+            return paged_decode_attention(q, k_cache, v_cache, block_tables,
+                                          seq_lens, block_size=bs,
+                                          impl=attn_impl, alibi=ab,
+                                          window=window)
+
+        x = _block(cfg, p, x, attn_fn)
+        return x, (k_cache, v_cache)
 
     x, (nk, nv) = jax.lax.scan(layer, x, (params["layers"], kv.k, kv.v))
-    x = rms_norm(x, params["final_norm"]["scale"], cfg.rms_norm_eps)
-    if cfg.tie_embeddings:
-        logits = jnp.einsum("sd,vd->sv", x,
-                            params["embed"]["embedding"].astype(x.dtype))
-    else:
-        logits = jnp.einsum("sd,dv->sv", x,
-                            params["lm_head"]["kernel"].astype(x.dtype))
+    x = norm(x, params["final_norm"], cfg)
+    logits = _unembed(params, x, cfg)
     return logits.astype(jnp.float32), BlockedKV(nk, nv)
 
 

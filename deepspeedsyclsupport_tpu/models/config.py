@@ -23,13 +23,27 @@ class ModelConfig:
     head_dim: Optional[int] = None      # None => hidden_size // num_heads
     max_seq_len: int = 4096
 
-    # Architecture knobs
+    # Architecture knobs. Together these cover the reference's per-arch policy
+    # zoo (deepspeed/module_inject/containers/*.py — llama, gpt2, opt, bloom,
+    # falcon, gptneox, gptj, phi, ...) as config axes on ONE model definition
+    # instead of 19 module-surgery policies.
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-5
     tie_embeddings: bool = False
     attn_impl: str = "auto"  # auto | xla | flash | ring | ulysses
-    activation: str = "silu"   # silu (SwiGLU) | gelu (GeGLU)
-    use_bias: bool = False
+    activation: str = "silu"   # silu | gelu | gelu_exact | relu
+    use_bias: bool = False     # biases on attention/MLP projections
+    qkv_bias: Optional[bool] = None  # override bias for q/k/v only (Qwen-style)
+    attn_out_bias: Optional[bool] = None  # override bias for attn out proj (gptj)
+    norm_type: str = "rmsnorm"      # rmsnorm | layernorm (learned bias)
+    pos_embed: str = "rope"         # rope | learned | alibi | none
+    pos_embed_offset: int = 0       # OPT stores positions at offset 2
+    rotary_pct: float = 1.0         # partial rotary (gpt-neox 0.25, phi 0.4)
+    mlp_type: str = "glu"           # glu (gated, 3 mats) | mlp (fc1/fc2)
+    parallel_block: bool = False    # attn+mlp both from norms of x (gptj/neox/falcon/phi)
+    shared_block_norm: bool = False  # parallel block with ONE norm (gptj/falcon-7b/phi)
+    embed_norm: bool = False        # layernorm right after embedding (bloom)
+    sliding_window: Optional[int] = None  # Mistral-style local attention window
 
     # MoE (Mixtral-family; reference: deepspeed/moe/sharded_moe.py)
     num_experts: int = 0            # 0 => dense MLP
@@ -59,6 +73,24 @@ class ModelConfig:
             self.num_kv_heads = self.num_heads
         if self.head_dim is None:
             self.head_dim = self.hidden_size // self.num_heads
+        if self.qkv_bias is None:
+            self.qkv_bias = self.use_bias
+        if self.attn_out_bias is None:
+            self.attn_out_bias = self.use_bias
+        if self.norm_type not in ("rmsnorm", "layernorm"):
+            raise ValueError(f"unknown norm_type {self.norm_type!r}")
+        if self.pos_embed not in ("rope", "learned", "alibi", "none"):
+            raise ValueError(f"unknown pos_embed {self.pos_embed!r}")
+        if self.mlp_type not in ("glu", "mlp"):
+            raise ValueError(f"unknown mlp_type {self.mlp_type!r}")
+        if self.shared_block_norm and not self.parallel_block:
+            raise ValueError("shared_block_norm requires parallel_block")
+
+    @property
+    def rotary_dim(self) -> int:
+        """Rotated prefix of head_dim (the rest passes through un-rotated)."""
+        rd = int(self.head_dim * self.rotary_pct)
+        return rd - rd % 2
 
     @property
     def q_dim(self) -> int:
@@ -79,7 +111,7 @@ class ModelConfig:
         """Approximate parameter count (embeddings + layers)."""
         d, f, v = self.hidden_size, self.intermediate_size, self.vocab_size
         attn = d * self.q_dim + 2 * d * self.kv_dim + self.q_dim * d
-        mlp = 3 * d * f
+        mlp = (3 if self.mlp_type == "glu" else 2) * d * f
         if self.num_experts > 0:
             mlp = mlp * self.num_experts + d * self.num_experts
         per_layer = attn + mlp + 2 * d
@@ -103,11 +135,56 @@ PRESETS = {
     "small": _p(vocab_size=8192, hidden_size=512, intermediate_size=1408,
                 num_layers=8, num_heads=8, num_kv_heads=8, max_seq_len=2048),
     # GPT-2/BERT-era scale (BASELINE config #1 family)
-    "gpt2-small": _p(vocab_size=50304, hidden_size=768, intermediate_size=2048,
+    # NOTE: 50257 matches real HF GPT-2 checkpoints for ingestion parity; pad
+    # vocab (e.g. 50304) via overrides when running vocab-TP at degree > 1
+    "gpt2-small": _p(vocab_size=50257, hidden_size=768, intermediate_size=3072,
                      num_layers=12, num_heads=12, max_seq_len=1024,
-                     tie_embeddings=True),
+                     tie_embeddings=True, norm_type="layernorm",
+                     pos_embed="learned", mlp_type="mlp", activation="gelu",
+                     use_bias=True),
+    "gpt2-xl": _p(vocab_size=50257, hidden_size=1600, intermediate_size=6400,
+                  num_layers=48, num_heads=25, max_seq_len=1024,
+                  tie_embeddings=True, norm_type="layernorm",
+                  pos_embed="learned", mlp_type="mlp", activation="gelu",
+                  use_bias=True),
     "bert-large-like": _p(vocab_size=30592, hidden_size=1024, intermediate_size=4096,
-                          num_layers=24, num_heads=16, max_seq_len=512),
+                          num_layers=24, num_heads=16, max_seq_len=512,
+                          norm_type="layernorm", pos_embed="learned",
+                          mlp_type="mlp", activation="gelu_exact",
+                          use_bias=True),
+    # The wider module_inject policy zoo (containers/{opt,bloom,gptneox,gptj}.py
+    # + v2 model_implementations/{opt,falcon,phi}) as config presets:
+    "opt-1.3b": _p(vocab_size=50272, hidden_size=2048, intermediate_size=8192,
+                   num_layers=24, num_heads=32, max_seq_len=2048,
+                   tie_embeddings=True, norm_type="layernorm",
+                   pos_embed="learned", pos_embed_offset=2, mlp_type="mlp",
+                   activation="relu", use_bias=True),
+    "bloom-7b1": _p(vocab_size=250880, hidden_size=4096, intermediate_size=16384,
+                    num_layers=30, num_heads=32, max_seq_len=2048,
+                    tie_embeddings=True, norm_type="layernorm",
+                    pos_embed="alibi", mlp_type="mlp", activation="gelu",
+                    use_bias=True, embed_norm=True),
+    "falcon-7b": _p(vocab_size=65024, hidden_size=4544, intermediate_size=18176,
+                    num_layers=32, num_heads=71, num_kv_heads=1,
+                    max_seq_len=2048, tie_embeddings=True,
+                    norm_type="layernorm", mlp_type="mlp",
+                    activation="gelu_exact",  # HF falcon uses erf gelu
+                    parallel_block=True, shared_block_norm=True),
+    "phi-2": _p(vocab_size=51200, hidden_size=2560, intermediate_size=10240,
+                num_layers=32, num_heads=32, max_seq_len=2048,
+                norm_type="layernorm", mlp_type="mlp", activation="gelu",
+                use_bias=True, rotary_pct=0.4, parallel_block=True,
+                shared_block_norm=True),
+    "gpt-neox-20b": _p(vocab_size=50432, hidden_size=6144, intermediate_size=24576,
+                       num_layers=44, num_heads=64, max_seq_len=2048,
+                       norm_type="layernorm", mlp_type="mlp",
+                       activation="gelu_exact",  # HF hidden_act="gelu" = erf
+                       use_bias=True, rotary_pct=0.25, parallel_block=True),
+    "gptj-6b": _p(vocab_size=50400, hidden_size=4096, intermediate_size=16384,
+                  num_layers=28, num_heads=16, max_seq_len=2048,
+                  norm_type="layernorm", mlp_type="mlp", activation="gelu",
+                  use_bias=True, qkv_bias=False, attn_out_bias=False,
+                  rotary_pct=0.25, parallel_block=True, shared_block_norm=True),
     # Llama-2 family (FastGen/ZeRO baselines; blogs/deepspeed-fastgen/README.md:135)
     "llama2-1b": _p(vocab_size=32000, hidden_size=2048, intermediate_size=5504,
                     num_layers=16, num_heads=16, num_kv_heads=16, max_seq_len=4096),
@@ -118,7 +195,8 @@ PRESETS = {
     "llama2-70b": _p(vocab_size=32000, hidden_size=8192, intermediate_size=28672,
                      num_layers=80, num_heads=64, num_kv_heads=8, max_seq_len=4096),
     "mistral-7b": _p(vocab_size=32000, hidden_size=4096, intermediate_size=14336,
-                     num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=8192),
+                     num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=8192,
+                     sliding_window=4096),
     "mixtral-8x7b": _p(vocab_size=32000, hidden_size=4096, intermediate_size=14336,
                        num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=8192,
                        num_experts=8, num_experts_per_tok=2),

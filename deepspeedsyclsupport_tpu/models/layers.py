@@ -54,24 +54,66 @@ def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float) -> jnp.ndarray:
     return (x * jax.lax.rsqrt(var + eps) * scale.astype(jnp.float32)).astype(dtype)
 
 
+def layer_norm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray,
+               eps: float) -> jnp.ndarray:
+    """LayerNorm with learned bias (reference ``csrc/transformer/inference/csrc/
+    layer_norm.cu``) — the GPT-2/OPT/BLOOM/Falcon-era norm."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(dtype)
+
+
+def norm(x: jnp.ndarray, p: Params, cfg: ModelConfig) -> jnp.ndarray:
+    """Norm dispatch on ``cfg.norm_type`` over a ``{"scale"[, "bias"]}`` leaf dict."""
+    if cfg.norm_type == "layernorm":
+        return layer_norm(x, p["scale"], p["bias"], cfg.rms_norm_eps)
+    return rms_norm(x, p["scale"], cfg.rms_norm_eps)
+
+
 # --------------------------------------------------------------------------- rope
 def rope_frequencies(head_dim: int, theta: float) -> np.ndarray:
     return 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float32) / head_dim))
 
 
-def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float,
+               rotary_dim: Optional[int] = None) -> jnp.ndarray:
     """Rotary embedding (reference kernel: ``csrc/transformer/inference/csrc/
-    apply_rotary_pos_emb.cu``). x: [B, S, H, D]; positions: [B, S] or [S]."""
+    apply_rotary_pos_emb.cu``). x: [B, S, H, D]; positions: [B, S] or [S].
+    ``rotary_dim < D`` rotates only the leading dims (GPT-NeoX/GPT-J/Phi
+    partial rotary; ingestion converts interleaved layouts to this split-half
+    convention by permuting q/k weight columns)."""
     head_dim = x.shape[-1]
-    freqs = jnp.asarray(rope_frequencies(head_dim, theta))
+    rd = head_dim if rotary_dim is None else rotary_dim
+    x_rot, x_pass = (x, None) if rd == head_dim else (x[..., :rd], x[..., rd:])
+    freqs = jnp.asarray(rope_frequencies(rd, theta))
     if positions.ndim == 1:
         positions = positions[None, :]
-    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, D/2]
-    cos = jnp.cos(angles)[:, :, None, :]  # [B, S, 1, D/2]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, rd/2]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B, S, 1, rd/2]
     sin = jnp.sin(angles)[:, :, None, :]
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    x1, x2 = jnp.split(x_rot.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
-    return out.astype(x.dtype)
+    out = out.astype(x.dtype)
+    return out if x_pass is None else jnp.concatenate([out, x_pass], axis=-1)
+
+
+def alibi_slopes(num_heads: int) -> np.ndarray:
+    """Per-head ALiBi slopes (reference builds these in
+    ``module_inject/containers/bloom.py``-served models via HF; standard
+    geometric schedule from the ALiBi paper, non-power-of-2 interpolation)."""
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(np.log2(n) - 3)))
+        return start * (start ** np.arange(n))
+
+    n = 2 ** int(np.floor(np.log2(num_heads)))
+    slopes = pow2_slopes(n)
+    if n < num_heads:
+        extra = pow2_slopes(2 * n)[0::2][: num_heads - n]
+        slopes = np.concatenate([slopes, extra])
+    return slopes.astype(np.float32)
 
 
 # --------------------------------------------------------------------------- attention
@@ -79,7 +121,12 @@ def reference_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                         causal: bool = True,
                         segment_ids: Optional[jnp.ndarray] = None,
                         kv_positions_below: Optional[jnp.ndarray] = None,
-                        kv_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                        kv_mask: Optional[jnp.ndarray] = None,
+                        alibi: Optional[jnp.ndarray] = None,
+                        window: Optional[int] = None,
+                        q_positions: Optional[jnp.ndarray] = None,
+                        kv_positions: Optional[jnp.ndarray] = None
+                        ) -> jnp.ndarray:
     """Exact softmax attention in jnp — the parity reference for the Pallas kernels
     (the role torch plays for the reference's kernel tests, SURVEY.md §4).
 
@@ -89,6 +136,9 @@ def reference_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     ``kv_mask``: [B, Skv] explicit slot-validity mask, ANDed in — needed when
     cache slot index ≠ token position (right-padded ragged batches, where pad
     slots sit between each prompt's end and the shared decode region).
+    ``alibi``: per-head slopes [H] — adds ``slope·(k_pos − q_pos)`` to logits
+    (BLOOM-family positional scheme). ``window``: sliding-window local
+    attention — queries see only the last ``window`` positions (Mistral).
     """
     b, sq, h, d = q.shape
     kvh = k.shape[2]
@@ -100,8 +150,28 @@ def reference_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
     skv = k.shape[1]
+    explicit_pos = q_positions is not None and kv_positions is not None
+    if explicit_pos:
+        # true logical positions (ragged decode: slot index ≠ position)
+        q_pos = q_positions.astype(jnp.int32)[:, None, :, None]
+        k_pos = kv_positions.astype(jnp.int32)[:, None, None, :]
+    elif kv_positions_below is not None:
+        q_pos = (kv_positions_below - 1).astype(jnp.int32)[:, None, :, None]
+        k_pos = jnp.broadcast_to(jnp.arange(skv, dtype=jnp.int32)[None, None,
+                                                                  None, :],
+                                 (b, 1, sq, skv))
+    else:
+        q_pos = (jnp.arange(sq, dtype=jnp.int32)
+                 + (skv - sq))[None, None, :, None]
+        k_pos = jnp.arange(skv, dtype=jnp.int32)[None, None, None, :]
+    if alibi is not None:
+        logits = logits + alibi.astype(jnp.float32)[None, :, None, None] * (
+            k_pos - q_pos).astype(jnp.float32)
     mask = None
-    if kv_positions_below is not None:
+    if explicit_pos:
+        if causal:
+            mask = k_pos <= q_pos  # position-space causality
+    elif kv_positions_below is not None:
         kv_idx = jnp.arange(skv)[None, None, :]
         mask = kv_idx < kv_positions_below[:, :, None]  # [B, Sq, Skv]
         mask = mask[:, None, :, :]
@@ -109,6 +179,9 @@ def reference_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         qi = jnp.arange(sq)[:, None]
         ki = jnp.arange(skv)[None, :]
         mask = (ki <= qi + (skv - sq))[None, None, :, :]
+    if window is not None:
+        wmask = (q_pos - k_pos) < window
+        mask = wmask if mask is None else jnp.logical_and(mask, wmask)
     if segment_ids is not None:
         seg = (segment_ids[:, None, :, None] == segment_ids[:, None, None, :]) \
             if segment_ids.shape[1] == sq and sq == skv else None
@@ -125,7 +198,8 @@ def reference_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 
 def _cached_flash_attention(q, k, v, causal, kv_positions_below, kv_mask,
-                            interpret=None):
+                            alibi=None, window=None, q_positions=None,
+                            kv_positions=None, interpret=None):
     """KV-cache attention through the flash kernel (the v1 engine's prefill
     and decode steps). Slot-space masks map onto the kernel's ragged mode:
     ``kv_positions_below`` becomes explicit q positions (query i sees slots
@@ -138,18 +212,28 @@ def _cached_flash_attention(q, k, v, causal, kv_positions_below, kv_mask,
 
     b, sq = q.shape[:2]
     skv = k.shape[1]
-    q_pos = None
     use_causal = causal
-    if kv_positions_below is not None:
-        q_pos = kv_positions_below.astype(jnp.int32) - 1     # [B, Sq]
+    if q_positions is not None and kv_positions is not None:
+        # true logical positions (ragged: slot ≠ position) — position-space
+        # causality, and alibi/window distances come out right
+        q_pos, kv_pos = (q_positions.astype(jnp.int32),
+                         kv_positions.astype(jnp.int32))
         use_causal = True
+    elif kv_positions_below is not None:
+        q_pos = kv_positions_below.astype(jnp.int32) - 1     # [B, Sq]
+        kv_pos = None
+        use_causal = True
+    else:
+        q_pos = kv_pos = None
     seg_q = seg_k = None
     if kv_mask is not None:
         seg_q = jnp.zeros((b, sq), jnp.int32)
         seg_k = jnp.where(kv_mask, 0, -1).astype(jnp.int32)
     return flash_attention(q, k, v, causal=use_causal,
                            segment_ids=seg_q, kv_segment_ids=seg_k,
-                           q_positions=q_pos, interpret=interpret)
+                           q_positions=q_pos, kv_positions=kv_pos,
+                           alibi=alibi, window=window,
+                           interpret=interpret)
 
 
 def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -157,27 +241,44 @@ def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
               causal: bool = True,
               segment_ids: Optional[jnp.ndarray] = None,
               kv_positions_below: Optional[jnp.ndarray] = None,
-              kv_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+              kv_mask: Optional[jnp.ndarray] = None,
+              alibi: Optional[jnp.ndarray] = None,
+              window: Optional[int] = None,
+              q_positions: Optional[jnp.ndarray] = None,
+              kv_positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Attention dispatch — the seam where Pallas/SP implementations plug in
     (reference analog: the op-binding indirection of
     ``ops/transformer/inference/op_binding/``)."""
     if impl == "auto":
         impl = "flash" if jax.default_backend() == "tpu" else "xla"
-    if kv_positions_below is not None or kv_mask is not None:
-        # cached-decode masking (slot-space causality + slot validity). The
-        # flash kernel handles it via explicit position arrays + kv segment
-        # ids; ring/ulysses are training patterns and fall back to xla.
+    if (kv_positions_below is not None or kv_mask is not None
+            or kv_positions is not None):
+        # cached-decode masking (slot validity + slot- or position-space
+        # causality). The flash kernel handles it via explicit position
+        # arrays + kv segment ids; ring/ulysses are training patterns and
+        # fall back to xla.
         if impl == "flash":
             return _cached_flash_attention(q, k, v, causal,
-                                           kv_positions_below, kv_mask)
+                                           kv_positions_below, kv_mask,
+                                           alibi=alibi, window=window,
+                                           q_positions=q_positions,
+                                           kv_positions=kv_positions)
         impl = "xla"
     if impl == "flash":
         from ..ops.flash_attention import flash_attention
 
         try:
-            return flash_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+            return flash_attention(q, k, v, causal=causal,
+                                   segment_ids=segment_ids, alibi=alibi,
+                                   window=window)
         except NotImplementedError:
             impl = "xla"
+    if impl in ("ring", "ulysses") and (alibi is not None
+                                        or window is not None):
+        # silently materializing O(S²) logits would defeat the point of SP
+        raise NotImplementedError(
+            f"attn_impl={impl!r} does not support alibi/sliding-window yet; "
+            f"use attn_impl='flash' or 'xla'")
     if impl == "ring":
         from ..parallel.ring_attention import ring_attention
 
@@ -189,7 +290,9 @@ def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                                  segment_ids=segment_ids)
     return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids,
                                kv_positions_below=kv_positions_below,
-                               kv_mask=kv_mask)
+                               kv_mask=kv_mask, alibi=alibi, window=window,
+                               q_positions=q_positions,
+                               kv_positions=kv_positions)
 
 
 # --------------------------------------------------------------------------- blocks
@@ -198,7 +301,8 @@ def attention_block(p: Params, x: jnp.ndarray, cfg: ModelConfig,
                     segment_ids: Optional[jnp.ndarray] = None,
                     kv_cache: Optional[Tuple] = None,
                     impl: Optional[str] = None,
-                    kv_mask: Optional[jnp.ndarray] = None):
+                    kv_mask: Optional[jnp.ndarray] = None,
+                    kv_positions: Optional[jnp.ndarray] = None):
     """Self-attention sublayer: qkv proj → RoPE → attention → out proj.
 
     With ``kv_cache=(k_cache, v_cache, write_pos)`` runs in decode mode: appends
@@ -207,16 +311,24 @@ def attention_block(p: Params, x: jnp.ndarray, cfg: ModelConfig,
     ``inference/v2/kernels/ragged_ops/``). Returns (out, new_kv_cache).
     """
     b, s, d = x.shape
-    q = jnp.einsum("bsd,dq->bsq", x, p["wq"]).reshape(
-        b, s, cfg.num_heads, cfg.head_dim)
-    k = jnp.einsum("bsd,dk->bsk", x, p["wk"]).reshape(
-        b, s, cfg.num_kv_heads, cfg.head_dim)
-    v = jnp.einsum("bsd,dk->bsk", x, p["wv"]).reshape(
-        b, s, cfg.num_kv_heads, cfg.head_dim)
+    q = jnp.einsum("bsd,dq->bsq", x, p["wq"])
+    k = jnp.einsum("bsd,dk->bsk", x, p["wk"])
+    v = jnp.einsum("bsd,dk->bsk", x, p["wv"])
+    if cfg.qkv_bias:
+        q = q + p["bq"].astype(q.dtype)
+        k = k + p["bk"].astype(k.dtype)
+        v = v + p["bv"].astype(v.dtype)
+    q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
+    k = k.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+    v = v.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
     q = constrain(q, BATCH, "seq", "model", None)
     k = constrain(k, BATCH, "seq", "model", None)
-    q = apply_rope(q, positions, cfg.rope_theta)
-    k = apply_rope(k, positions, cfg.rope_theta)
+    if cfg.pos_embed == "rope":
+        q = apply_rope(q, positions, cfg.rope_theta, cfg.rotary_dim)
+        k = apply_rope(k, positions, cfg.rope_theta, cfg.rotary_dim)
+    alibi = (jnp.asarray(alibi_slopes(cfg.num_heads))
+             if cfg.pos_embed == "alibi" else None)
+    window = cfg.sliding_window
 
     new_cache = None
     if kv_cache is not None:
@@ -224,31 +336,73 @@ def attention_block(p: Params, x: jnp.ndarray, cfg: ModelConfig,
         k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k, write_pos, axis=1)
         v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v, write_pos, axis=1)
         new_cache = (k_cache, v_cache, write_pos + s)
-        if kv_mask is not None:
-            # ragged right-padded batches: slot != position, so causality must
-            # be slot-space — query i of this chunk (written at write_pos+i)
-            # sees slots <= write_pos+i; kv_mask supplies validity of the rest
-            kv_below = write_pos + jnp.arange(s)[None, :] + 1
+        if kv_positions is not None:
+            # ragged with true per-slot positions supplied (engine knows
+            # slot→position): position-space causality, and alibi/window
+            # distances are computed on logical positions, not cache slots
+            out = attention(q, k_cache, v_cache, impl=impl or cfg.attn_impl,
+                            causal=True, kv_mask=kv_mask, alibi=alibi,
+                            window=window, q_positions=positions,
+                            kv_positions=kv_positions)
         else:
-            kv_below = positions + 1  # slot == position: at-or-before own pos
-        out = attention(q, k_cache, v_cache, impl=impl or cfg.attn_impl,
-                        causal=False, kv_positions_below=kv_below,
-                        kv_mask=kv_mask)
+            if kv_mask is not None:
+                # ragged right-padded batches without per-slot positions:
+                # causality must be slot-space — query i of this chunk
+                # (written at write_pos+i) sees slots <= write_pos+i;
+                # kv_mask supplies validity of the rest
+                kv_below = write_pos + jnp.arange(s)[None, :] + 1
+                if cfg.pos_embed == "alibi" or cfg.sliding_window:
+                    raise ValueError(
+                        "alibi/sliding-window ragged decode needs kv_positions"
+                        " (slot index ≠ logical position would skew distances)")
+            else:
+                kv_below = positions + 1  # slot == position: own pos or before
+            out = attention(q, k_cache, v_cache, impl=impl or cfg.attn_impl,
+                            causal=False, kv_positions_below=kv_below,
+                            kv_mask=kv_mask, alibi=alibi, window=window)
     else:
         out = attention(q, k, v, impl=impl or cfg.attn_impl, causal=True,
-                        segment_ids=segment_ids)
+                        segment_ids=segment_ids, alibi=alibi, window=window)
     out = out.reshape(b, s, cfg.q_dim)
     out = jnp.einsum("bsq,qd->bsd", out, p["wo"])
+    if cfg.attn_out_bias:
+        out = out + p["bo"].astype(out.dtype)
     return constrain(out, BATCH, "seq", None), new_cache
+
+
+def _activation(name: str):
+    return {"silu": jax.nn.silu, "gelu": jax.nn.gelu,
+            "gelu_exact": partial(jax.nn.gelu, approximate=False),
+            "relu": jax.nn.relu}[name]
 
 
 def glu_mlp(p: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
     """Gated-linear-unit MLP (SwiGLU/GeGLU). Reference fuses bias+activation in
     ``csrc/transformer/inference/csrc/gelu.cu`` / v2 ``gated_activations``; XLA
     fuses the same chain into the matmul epilogue on TPU."""
-    act = jax.nn.silu if cfg.activation == "silu" else jax.nn.gelu
+    act = _activation(cfg.activation)
     gate = jnp.einsum("bsd,df->bsf", x, p["w_gate"])
     up = jnp.einsum("bsd,df->bsf", x, p["w_up"])
     h = act(gate) * up
     h = constrain(h, BATCH, "seq", "model")
     return jnp.einsum("bsf,fd->bsd", h, p["w_down"])
+
+
+def std_mlp(p: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    """Two-matrix MLP (fc1 → act → fc2), the GPT-2/OPT/BLOOM/Falcon/Phi shape
+    (reference fused path: ``csrc/transformer/inference/csrc/gelu.cu``
+    fused_bias_gelu)."""
+    act = _activation(cfg.activation)
+    h = jnp.einsum("bsd,df->bsf", x, p["fc1"])
+    if cfg.use_bias:
+        h = h + p["b1"].astype(h.dtype)
+    h = act(h)
+    h = constrain(h, BATCH, "seq", "model")
+    out = jnp.einsum("bsf,fd->bsd", h, p["fc2"])
+    if cfg.use_bias:
+        out = out + p["b2"].astype(out.dtype)
+    return out
+
+
+def mlp_block(p: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    return std_mlp(p, x, cfg) if cfg.mlp_type == "mlp" else glu_mlp(p, x, cfg)

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .config import ModelConfig, get_config
-from .layers import BATCH, attention_block, constrain, glu_mlp, rms_norm
+from .layers import BATCH, attention_block, constrain, mlp_block, norm
 
 Params = Dict[str, Any]
 
@@ -54,21 +54,32 @@ class CausalLM:
         def dense(shape, key, scale=std):
             return (jax.random.normal(key, shape, jnp.float32) * scale)
 
+        def norm_params() -> Params:
+            p = {"scale": jnp.ones((cfg.hidden_size,), jnp.float32)}
+            if cfg.norm_type == "layernorm":
+                p["bias"] = jnp.zeros((cfg.hidden_size,), jnp.float32)
+            return p
+
         def layer_params(key) -> Params:
             ks = iter(jax.random.split(key, 16))
             d, q, kv, f = (cfg.hidden_size, cfg.q_dim, cfg.kv_dim,
                            cfg.intermediate_size)
-            p: Params = {
-                "attn_norm": {"scale": jnp.ones((d,), jnp.float32)},
-                "attn": {
-                    "wq": dense((d, q), next(ks)),
-                    "wk": dense((d, kv), next(ks)),
-                    "wv": dense((d, kv), next(ks)),
-                    "wo": dense((q, d), next(ks),
-                                scale=std / np.sqrt(2 * cfg.num_layers)),
-                },
-                "mlp_norm": {"scale": jnp.ones((d,), jnp.float32)},
+            attn: Params = {
+                "wq": dense((d, q), next(ks)),
+                "wk": dense((d, kv), next(ks)),
+                "wv": dense((d, kv), next(ks)),
+                "wo": dense((q, d), next(ks),
+                            scale=std / np.sqrt(2 * cfg.num_layers)),
             }
+            if cfg.qkv_bias:
+                attn.update(bq=jnp.zeros((q,), jnp.float32),
+                            bk=jnp.zeros((kv,), jnp.float32),
+                            bv=jnp.zeros((kv,), jnp.float32))
+            if cfg.attn_out_bias:
+                attn["bo"] = jnp.zeros((d,), jnp.float32)
+            p: Params = {"attn_norm": norm_params(), "attn": attn}
+            if not cfg.shared_block_norm:
+                p["mlp_norm"] = norm_params()
             if cfg.any_moe:
                 e = cfg.num_experts
                 p["moe"] = {
@@ -78,6 +89,15 @@ class CausalLM:
                     "w_down": dense((e, f, d), next(ks),
                                     scale=std / np.sqrt(2 * cfg.num_layers)),
                 }
+            elif cfg.mlp_type == "mlp":
+                p["mlp"] = {
+                    "fc1": dense((d, f), next(ks)),
+                    "fc2": dense((f, d), next(ks),
+                                 scale=std / np.sqrt(2 * cfg.num_layers)),
+                }
+                if cfg.use_bias:
+                    p["mlp"].update(b1=jnp.zeros((f,), jnp.float32),
+                                    b2=jnp.zeros((d,), jnp.float32))
             else:
                 p["mlp"] = {
                     "w_gate": dense((d, f), next(ks)),
@@ -97,8 +117,16 @@ class CausalLM:
             "embed": {"embedding": dense((cfg.vocab_size, cfg.hidden_size),
                                          next(keys))},
             "layers": layers,
-            "final_norm": {"scale": jnp.ones((cfg.hidden_size,), jnp.float32)},
+            "final_norm": norm_params(),
         }
+        if cfg.pos_embed == "learned":
+            # OPT-style tables carry pos_embed_offset extra rows and are
+            # indexed at position + offset (HF OPTLearnedPositionalEmbedding)
+            params["pos_embed"] = {"embedding": dense(
+                (cfg.max_seq_len + cfg.pos_embed_offset, cfg.hidden_size),
+                next(keys))}
+        if cfg.embed_norm:
+            params["embed_norm"] = norm_params()
         if not cfg.tie_embeddings:
             params["lm_head"] = {
                 "kernel": dense((cfg.hidden_size, cfg.vocab_size), next(keys))}
@@ -106,7 +134,7 @@ class CausalLM:
 
     # ------------------------------------------------------------------ forward
     def _layer(self, p: Params, x: jnp.ndarray, positions, segment_ids,
-               cache_slice, rng, kv_mask=None
+               cache_slice, rng, kv_mask=None, kv_positions=None
                ) -> Tuple[jnp.ndarray, Any, jnp.ndarray]:
         cfg = self.config
         # ZeRO-Inference: int8 QuantTensor leaves dequantize here, inside the
@@ -115,17 +143,27 @@ class CausalLM:
 
         p = dequantize_tree(p, jnp.dtype(cfg.dtype))
         dtype = x.dtype  # pin activation dtype: fp32 params must not promote bf16
-        h, new_cache = attention_block(
-            p["attn"], rms_norm(x, p["attn_norm"]["scale"], cfg.rms_norm_eps),
-            cfg, positions, segment_ids, cache_slice, kv_mask=kv_mask)
-        x = (x + h).astype(dtype)
-        y = rms_norm(x, p["mlp_norm"]["scale"], cfg.rms_norm_eps)
-        if cfg.any_moe:
-            from ..parallel.moe import moe_mlp
 
-            h, aux = moe_mlp(p["moe"], y, cfg, rng)
-        else:
-            h, aux = glu_mlp(p["mlp"], y, cfg), jnp.zeros((), jnp.float32)
+        def run_mlp(y):
+            if cfg.any_moe:
+                from ..parallel.moe import moe_mlp
+
+                return moe_mlp(p["moe"], y, cfg, rng)
+            return mlp_block(p["mlp"], y, cfg), jnp.zeros((), jnp.float32)
+
+        x_norm = norm(x, p["attn_norm"], cfg)
+        h, new_cache = attention_block(
+            p["attn"], x_norm, cfg, positions, segment_ids, cache_slice,
+            kv_mask=kv_mask, kv_positions=kv_positions)
+        if cfg.parallel_block:
+            # GPT-J/NeoX/Falcon/Phi residual form: x + attn(norm(x)) + mlp(·),
+            # with the MLP reading either the same norm (shared_block_norm)
+            # or its own norm of the SAME input x (NeoX two-norm form)
+            y = x_norm if cfg.shared_block_norm else norm(x, p["mlp_norm"], cfg)
+            m, aux = run_mlp(y)
+            return (x + h + m).astype(dtype), new_cache, aux
+        x = (x + h).astype(dtype)
+        h, aux = run_mlp(norm(x, p["mlp_norm"], cfg))
         return (x + h).astype(dtype), new_cache, aux
 
     def _forward(self, params: Params, input_ids: jnp.ndarray,
@@ -134,6 +172,7 @@ class CausalLM:
                  cache: Optional[KVCache] = None,
                  rng: Optional[jax.Array] = None,
                  kv_mask: Optional[jnp.ndarray] = None,
+                 kv_positions: Optional[jnp.ndarray] = None,
                  train: bool = True
                  ) -> Tuple[jnp.ndarray, Optional[KVCache], jnp.ndarray]:
         """Returns (logits [B,S,V] fp32, new_cache, total_aux_loss)."""
@@ -148,7 +187,16 @@ class CausalLM:
         from ..parallel.tensor_parallel import vocab_parallel_embedding
 
         x = vocab_parallel_embedding(params["embed"]["embedding"], input_ids)
+        if cfg.pos_embed == "learned":
+            # same Megatron masked-lookup+psum pattern as the vocab table —
+            # a plain take on a row-sharded table makes SPMD full-remat
+            table = params["pos_embed"]["embedding"]
+            pos = jnp.clip(positions + cfg.pos_embed_offset, 0,
+                           table.shape[0] - 1)
+            x = x + vocab_parallel_embedding(table, pos).astype(x.dtype)
         x = x.astype(jnp.dtype(cfg.dtype))
+        if cfg.embed_norm:
+            x = norm(x, params["embed_norm"], cfg)
         x = constrain(x, BATCH, "seq", None)
 
         def layer_fn(x, p, ck, cv, rng_l):
@@ -156,7 +204,8 @@ class CausalLM:
             if cache is not None:
                 cache_slice = (ck, cv, cache.write_pos)
             x, new_c, aux = self._layer(p, x, positions, segment_ids,
-                                        cache_slice, rng_l, kv_mask=kv_mask)
+                                        cache_slice, rng_l, kv_mask=kv_mask,
+                                        kv_positions=kv_positions)
             nck, ncv = (new_c[0], new_c[1]) if new_c is not None else (ck, cv)
             return x, nck, ncv, aux
 
@@ -245,7 +294,7 @@ class CausalLM:
                 new_cache = KVCache(jnp.stack(nks), jnp.stack(nvs),
                                     cache.write_pos + s)
 
-        x = rms_norm(x, params["final_norm"]["scale"], cfg.rms_norm_eps)
+        x = norm(x, params["final_norm"], cfg)
         if cfg.tie_embeddings:
             logits = jnp.einsum("bsd,vd->bsv", x,
                                 params["embed"]["embedding"].astype(x.dtype))
@@ -303,14 +352,16 @@ class CausalLM:
     def decode_step(self, params: Params, cache: KVCache,
                     tokens: jnp.ndarray,
                     positions: Optional[jnp.ndarray] = None,
-                    kv_mask: Optional[jnp.ndarray] = None
+                    kv_mask: Optional[jnp.ndarray] = None,
+                    kv_positions: Optional[jnp.ndarray] = None
                     ) -> Tuple[jnp.ndarray, KVCache]:
         """One incremental step over ``tokens`` [B, S] (S=1 for pure decode,
         larger for prefill/chunked-prefill). Returns (logits [B, S, V], cache).
         ``positions``/``kv_mask`` support ragged right-padded batches (see
         ``inference/engine.py``)."""
         logits, new_cache, _ = self._forward(params, tokens, positions=positions,
-                                             cache=cache, kv_mask=kv_mask)
+                                             cache=cache, kv_mask=kv_mask,
+                                             kv_positions=kv_positions)
         return logits, new_cache
 
     # ------------------------------------------------------------------ sharding
@@ -332,10 +383,12 @@ class CausalLM:
                 return pre + ("fsdp", "model")
             if s.endswith("wo"):
                 return pre + ("model", "fsdp")
-        if s.endswith(("mlp/w_gate", "mlp/w_up")):
+        if s.endswith(("mlp/w_gate", "mlp/w_up", "mlp/fc1")):
             return pre + ("fsdp", "model")
-        if s.endswith("mlp/w_down"):
+        if s.endswith(("mlp/w_down", "mlp/fc2")):
             return pre + ("model", "fsdp")
+        if s.endswith("pos_embed/embedding"):
+            return ("model", "fsdp")  # looked up via vocab_parallel_embedding
         if s.endswith("moe/router"):
             return pre + (None, None)
         if s.endswith(("moe/w_gate", "moe/w_up")):

@@ -47,13 +47,14 @@ def _round_up(x: int, m: int) -> int:
 
 
 def _mask(i, j, seg_q, seg_k, pos_q, pos_k, *, causal, q_len, kv_len,
-          block_q, block_k):
+          block_q, block_k, window=None):
     """[block_q, block_k] validity mask for tile (i, j).
 
     Causality compares explicit POSITION values (``pos_q``/``pos_k`` blocks)
     rather than array indices — for plain attention the positions are just
     (offset-shifted) iotas, and for the ragged packed-KV prefill path they
-    are each token's position within its own sequence.
+    are each token's position within its own sequence. ``window`` adds the
+    Mistral-style sliding-window bound (q sees the last ``window`` positions).
     """
     q_idx = i * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
@@ -62,32 +63,48 @@ def _mask(i, j, seg_q, seg_k, pos_q, pos_k, *, causal, q_len, kv_len,
     m = jnp.logical_and(q_idx < q_len, k_idx < kv_len)
     if causal:
         m = jnp.logical_and(m, pos_k <= pos_q)  # (1,bk) vs (bq,1) broadcast
+    if window is not None:
+        m = jnp.logical_and(m, pos_q - pos_k < window)
     m = jnp.logical_and(m, seg_q == seg_k)  # (bq,1) vs (1,bk) broadcast
     return m
 
 
 
 
-def _tile_live(seg_q, seg_k, pos_q, pos_k, causal):
+def _tile_live(seg_q, seg_k, pos_q, pos_k, causal, window=None):
     """Dynamic tile skip: a (q-block, kv-block) tile is dead when no q/kv
     segment pair can match, or (position-causal) when every kv position in
-    the block exceeds every q position. Pallas DMAs the blocks regardless,
-    but the three matmuls — the MXU cost — are skipped, which is what keeps
-    the packed ragged-prefill path O(tokens x own-context) in compute even
-    though the kv stream is the whole packed pool."""
+    the block exceeds every q position, or (sliding window) when every kv
+    position is below every q position's window. Pallas DMAs the blocks
+    regardless, but the three matmuls — the MXU cost — are skipped, which is
+    what keeps the packed ragged-prefill path O(tokens x own-context) in
+    compute even though the kv stream is the whole packed pool."""
     live = jnp.logical_and(jnp.min(seg_k) <= jnp.max(seg_q),
                            jnp.max(seg_k) >= jnp.min(seg_q))
     if causal:
         live = jnp.logical_and(live, jnp.min(pos_k) <= jnp.max(pos_q))
+    if window is not None:
+        live = jnp.logical_and(live,
+                               jnp.min(pos_q) - jnp.max(pos_k) < window)
     return live
 
 
+def _bias(s, ab_ref, pos_q, pos_k, use_alibi):
+    """ALiBi logit bias ``slope·(k_pos − q_pos)`` (zero on the diagonal,
+    increasingly negative with distance); the per-head slope arrives as a
+    [1,1] SMEM scalar block."""
+    if not use_alibi:
+        return s
+    return s + ab_ref[0, 0] * (pos_k - pos_q).astype(jnp.float32)
+
+
 # ------------------------------------------------------------------- forward
-def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, pq_ref, pk_ref,  # inputs
+def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, pq_ref, pk_ref,
+                ab_ref,                                # inputs
                 o_ref, lse_ref,                        # outputs
                 m_scr, l_scr, acc_scr,                 # scratch
                 *, scale, causal, skip_offset, q_len, kv_len,
-                block_q, block_k, num_kv_blocks):
+                block_q, block_k, num_kv_blocks, use_alibi, window):
     i = pl.program_id(2)
     j = pl.program_id(3)
 
@@ -102,9 +119,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, pq_ref, pk_ref,  # inputs
         k = k_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        s = _bias(s, ab_ref, pq_ref[0], pk_ref[0], use_alibi)
         mask = _mask(i, j, sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0],
                      causal=causal, q_len=q_len, kv_len=kv_len,
-                     block_q=block_q, block_k=block_k)
+                     block_q=block_q, block_k=block_k, window=window)
         s = jnp.where(mask, s, NEG_INF)
         m_prev, l_prev = m_scr[...], l_scr[...]
         m_cur = jnp.max(s, axis=1, keepdims=True)          # [bq, 1]
@@ -120,7 +138,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, pq_ref, pk_ref,  # inputs
                                  preferred_element_type=jnp.float32)
         acc_scr[...] = acc_scr[...] * alpha[:, :1] + pv
 
-    live = _tile_live(sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0], causal)
+    live = _tile_live(sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0], causal,
+                      window)
     if skip_offset is not None:
         # default-position causal: tiles strictly above the shifted diagonal
         # contribute nothing (custom positions rely on the dynamic skip)
@@ -142,10 +161,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, pq_ref, pk_ref,  # inputs
 
 # ------------------------------------------------------------------ backward
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
-               pq_ref, pk_ref,
+               pq_ref, pk_ref, ab_ref,
                dq_ref, dq_scr,
                *, scale, causal, skip_offset, q_len, kv_len,
-               block_q, block_k, num_kv_blocks):
+               block_q, block_k, num_kv_blocks, use_alibi, window):
     i = pl.program_id(2)
     j = pl.program_id(3)
 
@@ -160,9 +179,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
         do = do_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        s = _bias(s, ab_ref, pq_ref[0], pk_ref[0], use_alibi)
         mask = _mask(i, j, sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0],
                      causal=causal, q_len=q_len, kv_len=kv_len,
-                     block_q=block_q, block_k=block_k)
+                     block_q=block_q, block_k=block_k, window=window)
         p = jnp.where(mask, jnp.exp(s - lse_ref[0, 0]), 0.0)   # [bq, bk]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -171,7 +191,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    live = _tile_live(sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0], causal)
+    live = _tile_live(sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0], causal,
+                      window)
     if skip_offset is not None:
         @pl.when(jnp.logical_and(
             (i + 1) * block_q - 1 + skip_offset >= j * block_k, live))
@@ -188,10 +209,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
-                pq_ref, pk_ref,
+                pq_ref, pk_ref, ab_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
                 *, scale, causal, skip_offset, q_len, kv_len,
-                block_q, block_k, num_q_blocks):
+                block_q, block_k, num_q_blocks, use_alibi, window):
     j = pl.program_id(2)   # kv block (outer)
     i = pl.program_id(3)   # q block (inner, sequential accumulation)
 
@@ -207,9 +228,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
         do = do_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        s = _bias(s, ab_ref, pq_ref[0], pk_ref[0], use_alibi)
         mask = _mask(i, j, sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0],
                      causal=causal, q_len=q_len, kv_len=kv_len,
-                     block_q=block_q, block_k=block_k)
+                     block_q=block_q, block_k=block_k, window=window)
         p = jnp.where(mask, jnp.exp(s - lse_ref[0, 0]), 0.0)   # [bq, bk]
         dv_scr[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -221,7 +243,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                 # [bk, D]
 
-    live = _tile_live(sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0], causal)
+    live = _tile_live(sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0], causal,
+                      window)
     if skip_offset is not None:
         @pl.when(jnp.logical_and(
             (i + 1) * block_q - 1 + skip_offset >= j * block_k, live))
@@ -239,8 +262,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
 
 
 # ------------------------------------------------------------- pallas_call’s
-def _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, *, scale, causal,
-              skip_offset, q_len, kv_len, block_q, block_k, interpret):
+def _alibi_spec():
+    return pl.BlockSpec((1, 1), lambda b, h, i, j: (h, 0),
+                        memory_space=pltpu.SMEM)
+
+
+def _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, ab, *, scale, causal,
+              skip_offset, q_len, kv_len, block_q, block_k, use_alibi,
+              window, interpret):
     b, h, sq, d = q.shape
     kvh = k.shape[1]
     skv = k.shape[2]
@@ -249,7 +278,8 @@ def _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, *, scale, causal,
     kern = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, skip_offset=skip_offset,
         q_len=q_len, kv_len=kv_len, block_q=block_q,
-        block_k=block_k, num_kv_blocks=grid[3])
+        block_k=block_k, num_kv_blocks=grid[3], use_alibi=use_alibi,
+        window=window)
     return pl.pallas_call(
         kern,
         grid=grid,
@@ -263,6 +293,7 @@ def _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, *, scale, causal,
             pl.BlockSpec((1, 1, block_k), lambda b, h, i, j: (b, 0, j)),
             pl.BlockSpec((1, block_q, 1), lambda b, h, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, block_k), lambda b, h, i, j: (b, 0, j)),
+            _alibi_spec(),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
@@ -281,12 +312,12 @@ def _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, *, scale, causal,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v, seg_q, seg_k, pos_q, pos_k)
+    )(q, k, v, seg_q, seg_k, pos_q, pos_k, ab)
 
 
-def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, *, scale,
-              causal, skip_offset, q_len, kv_len, block_q, block_k,
-              interpret):
+def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab, *,
+              scale, causal, skip_offset, q_len, kv_len, block_q, block_k,
+              use_alibi, window, interpret):
     b, h, sq, d = q.shape
     kvh = k.shape[1]
     skv = k.shape[2]
@@ -295,7 +326,7 @@ def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, *, scale,
     nq, nkv = sq // block_q, skv // block_k
     common = dict(scale=scale, causal=causal, skip_offset=skip_offset,
                   q_len=q_len, kv_len=kv_len, block_q=block_q,
-                  block_k=block_k)
+                  block_k=block_k, use_alibi=use_alibi, window=window)
     q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0))
     kv_spec = pl.BlockSpec((1, 1, block_k, d),
                            lambda b, h, i, j: (b, h // g, j, 0))
@@ -307,7 +338,7 @@ def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, *, scale,
         functools.partial(_dq_kernel, num_kv_blocks=nkv, **common),
         grid=(b, h, nq, nkv),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec,
-                  sq_spec, sk_spec, sq_spec, sk_spec],
+                  sq_spec, sk_spec, sq_spec, sk_spec, _alibi_spec()],
         out_specs=pl.BlockSpec((1, 1, block_q, d),
                                lambda b, h, i, j: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, sq, d), jnp.float32),
@@ -316,7 +347,7 @@ def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, *, scale,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k)
+    )(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab)
 
     # grid reordered: kv block outer, q block inner (sequential accumulation)
     q_spec2 = pl.BlockSpec((1, 1, block_q, d), lambda b, h, j, i: (b, h, i, 0))
@@ -328,11 +359,13 @@ def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, *, scale,
     sk_spec2 = pl.BlockSpec((1, 1, block_k), lambda b, h, j, i: (b, 0, j))
     dkv_out = pl.BlockSpec((1, 1, block_k, d),
                            lambda b, h, j, i: (b, h, j, 0))
+    ab_spec2 = pl.BlockSpec((1, 1), lambda b, h, j, i: (h, 0),
+                            memory_space=pltpu.SMEM)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, num_q_blocks=nq, **common),
         grid=(b, h, nkv, nq),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2,
-                  sq_spec2, sk_spec2, sq_spec2, sk_spec2],
+                  sq_spec2, sk_spec2, sq_spec2, sk_spec2, ab_spec2],
         out_specs=[dkv_out, dkv_out],
         out_shape=[jax.ShapeDtypeStruct((b, h, skv, d), jnp.float32),
                    jax.ShapeDtypeStruct((b, h, skv, d), jnp.float32)],
@@ -342,7 +375,7 @@ def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, *, scale,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k)
+    )(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab)
     if g > 1:
         dk = dk.reshape(b, kvh, g, skv, d).sum(axis=2)
         dv = dv.reshape(b, kvh, g, skv, d).sum(axis=2)
@@ -352,29 +385,31 @@ def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, *, scale,
 # ----------------------------------------------------------------- custom_vjp
 @functools.lru_cache(maxsize=None)
 def _make_flash(head_dim, causal, skip_offset, q_len, kv_len, block_q,
-                block_k, interpret):
+                block_k, use_alibi, window, interpret):
     call_kw = dict(scale=1.0 / np.sqrt(head_dim), causal=causal,
                    skip_offset=skip_offset, q_len=q_len, kv_len=kv_len,
-                   block_q=block_q, block_k=block_k, interpret=interpret)
+                   block_q=block_q, block_k=block_k, use_alibi=use_alibi,
+                   window=window, interpret=interpret)
 
     @jax.custom_vjp
-    def f(q, k, v, seg_q, seg_k, pos_q, pos_k):
-        o, _ = _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, **call_kw)
+    def f(q, k, v, seg_q, seg_k, pos_q, pos_k, ab):
+        o, _ = _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, ab, **call_kw)
         return o
 
-    def f_fwd(q, k, v, seg_q, seg_k, pos_q, pos_k):
-        o, lse = _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, **call_kw)
-        return o, (q, k, v, seg_q, seg_k, pos_q, pos_k, o, lse)
+    def f_fwd(q, k, v, seg_q, seg_k, pos_q, pos_k, ab):
+        o, lse = _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, ab, **call_kw)
+        return o, (q, k, v, seg_q, seg_k, pos_q, pos_k, ab, o, lse)
 
     def f_bwd(res, do):
-        q, k, v, seg_q, seg_k, pos_q, pos_k, o, lse = res
+        q, k, v, seg_q, seg_k, pos_q, pos_k, ab, o, lse = res
         delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                         axis=-1, keepdims=True)            # [B,H,Sq,1]
         dq, dk, dv = _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k,
-                               pos_q, pos_k, **call_kw)
+                               pos_q, pos_k, ab, **call_kw)
         zero = lambda x: np.zeros(x.shape, jax.dtypes.float0)
         return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
-                zero(seg_q), zero(seg_k), zero(pos_q), zero(pos_k))
+                zero(seg_q), zero(seg_k), zero(pos_q), zero(pos_k),
+                jnp.zeros_like(ab))
 
     f.defvjp(f_fwd, f_bwd)
     return f
@@ -387,6 +422,8 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     kv_segment_ids: Optional[jnp.ndarray] = None,
                     q_positions: Optional[jnp.ndarray] = None,
                     kv_positions: Optional[jnp.ndarray] = None,
+                    alibi: Optional[jnp.ndarray] = None,
+                    window: Optional[int] = None,
                     block_q: int = 512, block_k: int = 512,
                     interpret: Optional[bool] = None) -> jnp.ndarray:
     """Flash attention over ``q [B,Sq,H,D]``, ``k/v [B,Skv,KVH,D]``.
@@ -396,7 +433,10 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     boundaries. For ragged cross-attention (the v2 packed-KV prefill path)
     pass ``kv_segment_ids [B,Skv]`` plus explicit ``q_positions [B,Sq]`` /
     ``kv_positions [B,Skv]`` — causality then compares in-sequence
-    positions instead of array indices. Returns ``[B,Sq,H,D]`` in q's
+    positions instead of array indices. ``alibi``: per-head slopes [H]
+    (BLOOM positional scheme, biasing logits by slope·(k_pos − q_pos));
+    ``window``: sliding-window local attention (Mistral), with dead tiles
+    outside the window skipped on the MXU. Returns ``[B,Sq,H,D]`` in q's
     dtype. Off-TPU runs in interpret mode.
     """
     if interpret is None:
@@ -461,10 +501,16 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     pos_k = jnp.pad(kv_pos, ((0, 0), (0, skv_p - skv)),
                     constant_values=2**30)[:, None, :]
 
+    if alibi is not None:
+        ab = jnp.asarray(alibi, jnp.float32).reshape(h, 1)
+    else:
+        ab = jnp.zeros((h, 1), jnp.float32)
     fn = _make_flash(int(d), bool(causal),
                      None if skip_offset is None else int(skip_offset),
                      int(sq), int(skv), int(block_q), int(block_k),
+                     alibi is not None,
+                     None if window is None else int(window),
                      bool(interpret))
-    out = fn(qt, kt, vt, seg_q, seg_k, pos_q, pos_k)      # [B,H,Sq_p,D_p]
+    out = fn(qt, kt, vt, seg_q, seg_k, pos_q, pos_k, ab)  # [B,H,Sq_p,D_p]
     out = out[:, :, :sq, :d]
     return jnp.transpose(out, (0, 2, 1, 3))

@@ -37,10 +37,11 @@ NEG_INF = -1e30
 
 # --------------------------------------------------------------------- kernel
 def _decode_kernel(block_tables_ref, seq_lens_ref,  # scalar prefetch
-                   q_ref, k_hbm, v_hbm,             # tensors
+                   q_ref, k_hbm, v_hbm, ab_ref,     # tensors
                    out_ref,                         # output
                    k_vmem, v_vmem, sem,             # scratch (double-buffered)
-                   *, block_size: int, max_blocks: int):
+                   *, block_size: int, max_blocks: int, use_alibi: bool,
+                   window):
     s = pl.program_id(0)
     seq_len = seq_lens_ref[s]
     q = q_ref[0].astype(jnp.float32)          # [H, D]
@@ -48,6 +49,7 @@ def _decode_kernel(block_tables_ref, seq_lens_ref,  # scalar prefetch
     kvh = k_vmem.shape[2]
     g = h // kvh
     q_g = q.reshape(kvh, g, d)
+    q_pos = seq_len - 1  # decode: the query IS the newest cached token
 
     def copies(j, slot):
         blk = block_tables_ref[s, j]
@@ -92,7 +94,12 @@ def _decode_kernel(block_tables_ref, seq_lens_ref,  # scalar prefetch
             preferred_element_type=jnp.float32) / np.sqrt(d)
         pos = j * block_size + jax.lax.broadcasted_iota(
             jnp.int32, (kvh, g, block_size), 2)
+        if use_alibi:
+            scores = scores + ab_ref[...].astype(jnp.float32) * (
+                pos - q_pos).astype(jnp.float32)
         valid = jnp.logical_and(pos < seq_len, active)
+        if window is not None:
+            valid = jnp.logical_and(valid, q_pos - pos < window)
         scores = jnp.where(valid, scores, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
@@ -118,12 +125,19 @@ def _decode_kernel(block_tables_ref, seq_lens_ref,  # scalar prefetch
 
 def paged_decode_attention_pallas(q, k_cache, v_cache, block_tables, seq_lens,
                                   *, block_size: int,
+                                  alibi=None, window=None,
                                   interpret: bool = False):
     """q: [S, H, D]; k/v_cache: [num_slots, KVH, D]; block_tables: [S, Bps];
-    seq_lens: [S] valid KV tokens per slot. Returns [S, H, D]."""
+    seq_lens: [S] valid KV tokens per slot. ``alibi``: per-head slopes [H];
+    ``window``: sliding-window bound. Returns [S, H, D]."""
     s, h, d = q.shape
     kvh = k_cache.shape[1]
+    g = h // kvh
     max_blocks = block_tables.shape[1]
+    if alibi is not None:
+        ab = jnp.asarray(alibi, jnp.float32).reshape(kvh, g, 1)
+    else:
+        ab = jnp.zeros((kvh, g, 1), jnp.float32)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(s,),
@@ -132,6 +146,8 @@ def paged_decode_attention_pallas(q, k_cache, v_cache, block_tables, seq_lens,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pl.ANY),   # K stays in HBM
             pl.BlockSpec(memory_space=pl.ANY),   # V stays in HBM
+            pl.BlockSpec((kvh, g, 1), lambda i, *_: (0, 0, 0),
+                         memory_space=pltpu.VMEM),  # slopes: one tiny block
         ],
         out_specs=pl.BlockSpec((1, h, d), lambda i, *_: (i, 0, 0),
                                memory_space=pltpu.VMEM),
@@ -142,19 +158,22 @@ def paged_decode_attention_pallas(q, k_cache, v_cache, block_tables, seq_lens,
         ],
     )
     kernel = functools.partial(_decode_kernel, block_size=block_size,
-                               max_blocks=max_blocks)
+                               max_blocks=max_blocks,
+                               use_alibi=alibi is not None,
+                               window=None if window is None else int(window))
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((s, h, d), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
     )(jnp.asarray(block_tables, jnp.int32), jnp.asarray(seq_lens, jnp.int32),
-      q, k_cache, v_cache)
+      q, k_cache, v_cache, ab)
 
 
 # ------------------------------------------------------------------ reference
 def paged_decode_attention_reference(q, k_cache, v_cache, block_tables,
-                                     seq_lens, *, block_size: int):
+                                     seq_lens, *, block_size: int,
+                                     alibi=None, window=None):
     """Exact jnp implementation (parity target + off-TPU fallback)."""
     s, h, d = q.shape
     kvh = k_cache.shape[1]
@@ -170,7 +189,13 @@ def paged_decode_attention_reference(q, k_cache, v_cache, block_tables,
         v_seq = jnp.repeat(v_seq, rep, axis=2)
     logits = jnp.einsum("shd,schd->shc", q.astype(jnp.float32),
                         k_seq) / np.sqrt(d)
+    q_pos = (seq_lens - 1)[:, None, None]      # the newest cached token
+    if alibi is not None:
+        logits = logits + jnp.asarray(alibi, jnp.float32)[None, :, None] * (
+            j[None, None, :] - q_pos).astype(jnp.float32)
     mask = (j[None, :] < seq_lens[:, None])[:, None, :]
+    if window is not None:
+        mask = jnp.logical_and(mask, q_pos - j[None, None, :] < window)
     logits = jnp.where(mask, logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("shc,schd->shd", probs, v_seq)
@@ -178,17 +203,20 @@ def paged_decode_attention_reference(q, k_cache, v_cache, block_tables,
 
 
 def paged_decode_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
-                           block_size: int, impl: str = "auto"):
+                           block_size: int, impl: str = "auto",
+                           alibi=None, window=None):
     """Dispatch (the op-binding seam, like ``models/layers.attention``)."""
     if impl == "auto":
         impl = "pallas" if jax.default_backend() == "tpu" else "xla"
     if impl == "pallas":
         return paged_decode_attention_pallas(
             q, k_cache, v_cache, block_tables, seq_lens,
-            block_size=block_size)
+            block_size=block_size, alibi=alibi, window=window)
     if impl == "pallas_interpret":
         return paged_decode_attention_pallas(
             q, k_cache, v_cache, block_tables, seq_lens,
-            block_size=block_size, interpret=True)
+            block_size=block_size, alibi=alibi, window=window,
+            interpret=True)
     return paged_decode_attention_reference(
-        q, k_cache, v_cache, block_tables, seq_lens, block_size=block_size)
+        q, k_cache, v_cache, block_tables, seq_lens, block_size=block_size,
+        alibi=alibi, window=window)

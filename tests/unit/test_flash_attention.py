@@ -195,3 +195,57 @@ class TestCachedDecodeFlash:
                                       interpret=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-4, atol=2e-4)
+
+
+class TestAlibiAndWindow:
+    """ALiBi logit bias + sliding-window masking (BLOOM / Mistral support in
+    the one kernel family; reference analogs: module_inject bloom container's
+    alibi path, mistral sliding window in v2 model implementations)."""
+
+    def test_alibi_parity(self):
+        from deepspeedsyclsupport_tpu.models.layers import alibi_slopes
+
+        q, k, v = _qkv(11, h=4, kvh=2)
+        sl = jnp.asarray(alibi_slopes(4))
+        ref = reference_attention(q, k, v, causal=True, alibi=sl)
+        got = flash_attention(q, k, v, causal=True, alibi=sl, interpret=True,
+                              block_q=128, block_k=128)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_window_parity(self):
+        q, k, v = _qkv(12)
+        ref = reference_attention(q, k, v, causal=True, window=64)
+        got = flash_attention(q, k, v, causal=True, window=64, interpret=True,
+                              block_q=128, block_k=128)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_alibi_window_grads(self):
+        from deepspeedsyclsupport_tpu.models.layers import alibi_slopes
+
+        q, k, v = _qkv(13, sq=128, d=32)
+        sl = jnp.asarray(alibi_slopes(4))
+
+        def f(fn):
+            def loss(q, k, v):
+                return (fn(q, k, v) ** 2).sum()
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        g_ref = f(lambda q, k, v: reference_attention(
+            q, k, v, causal=True, alibi=sl, window=96))
+        g_got = f(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, alibi=sl, window=96, interpret=True,
+            block_q=128, block_k=128))
+        for a, b in zip(g_got, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5, rtol=5e-5)
+
+    def test_alibi_slopes_schedule(self):
+        from deepspeedsyclsupport_tpu.models.layers import alibi_slopes
+
+        s8 = alibi_slopes(8)
+        np.testing.assert_allclose(s8, [2 ** (-i) for i in range(1, 9)],
+                                   rtol=1e-6)
+        s6 = alibi_slopes(6)           # non-power-of-2 interpolation
+        assert s6.shape == (6,) and np.all(s6 > 0) and np.all(np.diff(s6[:4]) < 0)

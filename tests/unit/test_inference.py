@@ -183,3 +183,36 @@ class TestConfig:
     def test_unknown_key_rejected(self):
         with pytest.raises(ValueError):
             DSTpuInferenceConfig.from_config({"definitely_not_a_key": 1})
+
+
+class TestRaggedArchZoo:
+    """Ragged (right-padded) v1 generate for position-sensitive architectures:
+    ALiBi and sliding-window distances must be computed on logical positions,
+    not cache slots (the kv_positions path in ``models/layers.attention_block``
+    — slot index ≠ position once padding and the shared decode region exist)."""
+
+    def _shrunk(self, **kw):
+        import dataclasses
+
+        from deepspeedsyclsupport_tpu.models import get_config
+
+        cfg = get_config("tiny")
+        return dataclasses.replace(cfg, dtype="float32", **kw)
+
+    @pytest.mark.parametrize("kw", [dict(pos_embed="alibi"),
+                                    dict(sliding_window=4)],
+                             ids=["alibi", "window"])
+    def test_ragged_matches_individual(self, kw):
+        model = build_model(self._shrunk(**kw))
+        params = model.init_params()
+        eng = _engine(model, params)
+        p1 = np.array([7, 3, 11], dtype=np.int32)
+        p2 = np.array([4, 100, 42, 8, 19], dtype=np.int32)
+        batch = np.zeros((2, 5), np.int32)
+        batch[0, :3] = p1
+        batch[1, :] = p2
+        got = np.asarray(eng.generate(jnp.asarray(batch),
+                                      prompt_lens=jnp.array([3, 5]),
+                                      max_new_tokens=6))
+        assert list(got[0]) == _naive_greedy(model, params, p1, 6)
+        assert list(got[1]) == _naive_greedy(model, params, p2, 6)
