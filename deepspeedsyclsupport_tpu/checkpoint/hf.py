@@ -3,24 +3,28 @@
 The TPU-native analog of the reference's model-integration stack:
 
 * the 19 per-architecture policies that map HF module trees onto fused
-  containers (``deepspeed/module_inject/containers/{llama,llama2,...}.py``,
-  ``replace_module.py:182``),
+  containers (``deepspeed/module_inject/containers/{llama,gpt2,opt,bloom,
+  gptneox,gptj,...}.py``, ``replace_module.py:182``),
 * the v2 checkpoint engines streaming HF shards
   (``deepspeed/inference/v2/checkpoint/huggingface_engine.py:1``), and
 * the flat-parameter mapping DSL (``inference/v2/model_implementations/
   layer_container_base.py``, ``flat_model_helpers.py``).
 
 Because the framework owns the model definition (``models/transformer.py``),
-"policy" collapses to a *name map*: HF tensor names → pytree paths, with the
-orientation transpose (torch ``nn.Linear`` stores ``[out, in]``; our einsum
-contracts ``[in, out]``). Streaming discipline: tensors are read one at a time
-from safetensors/torch shards, assembled per-leaf (stacked layer leaves are
-filled layer by layer), pushed to device against the target sharding, and the
-host buffer freed — peak host memory is one stacked leaf, never the model.
+a "policy" collapses to a *leaf map*: our pytree leaf path → (HF tensor name,
+transform). Transforms cover the orientation transpose (torch ``nn.Linear``
+stores ``[out, in]``; our einsums contract ``[in, out]``), Conv1D's already-
+``[in, out]`` layout (GPT-2), fused-QKV splits in each family's layout
+(BLOOM/NeoX per-head ``[H, 3, hd]``, Falcon's q-then-kv concat), and GPT-J's
+interleaved-rotary → split-half column permutation. Streaming discipline:
+tensors are read one at a time from safetensors/torch shards, assembled
+per-leaf (stacked layer leaves are filled layer by layer), pushed to device
+against the target sharding, and the host buffer freed — peak host memory is
+one stacked leaf, never the model.
 
-Supported families (same set the reference's FastGen serves first-class):
-Llama/Llama-2/-3, Mistral, Mixtral (MoE), plus anything config-compatible
-(Qwen2-style GQA dense models load through the same map).
+Supported families: Llama/-2/-3, Mistral, Mixtral (MoE), Qwen2, GPT-2, OPT,
+BLOOM, Falcon (multi-query), GPT-NeoX, GPT-J, Phi — the superset of what the
+reference's FastGen zoo serves first-class.
 """
 import json
 import os
@@ -44,12 +48,15 @@ BIN_SINGLE = "pytorch_model.bin"
 
 # --------------------------------------------------------------------- config
 def _map_activation(act: str) -> str:
-    """HF ``hidden_act`` → our activation. Unknown values raise — silently
-    substituting SwiGLU would load cleanly and generate garbage."""
-    known = {"silu": "silu", "swish": "silu", "gelu": "gelu",
-             # jax.nn.gelu defaults to the tanh approximation, which is what
-             # these HF names mean
-             "gelu_new": "gelu", "gelu_pytorch_tanh": "gelu"}
+    """HF ``hidden_act``/``activation_function`` → ours. HF's bare "gelu" is
+    the exact erf form; "gelu_new"/"gelu_fast"/"gelu_pytorch_tanh" are tanh
+    approximations. Unknown values raise — silently substituting would load
+    cleanly and generate garbage."""
+    known = {"silu": "silu", "swish": "silu",
+             "gelu": "gelu_exact",
+             "gelu_new": "gelu", "gelu_fast": "gelu",
+             "gelu_pytorch_tanh": "gelu",
+             "relu": "relu"}
     if act not in known:
         raise ValueError(
             f"unsupported hidden_act {act!r} (supported: {sorted(known)})")
@@ -57,27 +64,153 @@ def _map_activation(act: str) -> str:
 
 
 def config_from_hf(hf: Dict[str, Any], **overrides) -> ModelConfig:
-    """HF ``config.json`` dict → :class:`ModelConfig` (the per-arch policy's
-    config half; reference containers read the same fields off HF configs)."""
-    kw = dict(
-        vocab_size=hf.get("vocab_size", 32000),
-        hidden_size=hf.get("hidden_size", 4096),
-        intermediate_size=hf.get("intermediate_size", 11008),
-        num_layers=hf.get("num_hidden_layers", 32),
-        num_heads=hf.get("num_attention_heads", 32),
-        num_kv_heads=hf.get("num_key_value_heads",
-                            hf.get("num_attention_heads", 32)),
-        head_dim=hf.get("head_dim"),
-        max_seq_len=hf.get("max_position_embeddings", 4096),
-        rope_theta=float(hf.get("rope_theta", 10000.0)),
-        rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
-        tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
-        activation=_map_activation(hf.get("hidden_act", "silu")),
-    )
-    if hf.get("model_type") == "mixtral" or "num_local_experts" in hf:
-        kw.update(num_experts=hf.get("num_local_experts", 8),
-                  num_experts_per_tok=hf.get("num_experts_per_tok", 2),
-                  aux_loss_coef=float(hf.get("router_aux_loss_coef", 0.01)))
+    """HF ``config.json`` dict → :class:`ModelConfig` — the config half of the
+    per-arch policy (reference containers read the same fields)."""
+    mt = hf.get("model_type", "llama")
+    eps = float(hf.get("rms_norm_eps",
+                       hf.get("layer_norm_epsilon",
+                              hf.get("layer_norm_eps", 1e-5))))
+    if mt == "gpt2":
+        d = hf.get("n_embd", 768)
+        kw = dict(vocab_size=hf.get("vocab_size", 50257), hidden_size=d,
+                  intermediate_size=hf.get("n_inner") or 4 * d,
+                  num_layers=hf.get("n_layer", 12),
+                  num_heads=hf.get("n_head", 12),
+                  max_seq_len=hf.get("n_positions", 1024),
+                  tie_embeddings=True, norm_type="layernorm",
+                  pos_embed="learned", mlp_type="mlp", use_bias=True,
+                  rms_norm_eps=eps,
+                  activation=_map_activation(
+                      hf.get("activation_function", "gelu_new")))
+    elif mt == "opt":
+        kw = dict(vocab_size=hf.get("vocab_size", 50272),
+                  hidden_size=hf.get("hidden_size", 768),
+                  intermediate_size=hf.get("ffn_dim",
+                                           4 * hf.get("hidden_size", 768)),
+                  num_layers=hf.get("num_hidden_layers", 12),
+                  num_heads=hf.get("num_attention_heads", 12),
+                  max_seq_len=hf.get("max_position_embeddings", 2048),
+                  tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
+                  norm_type="layernorm", pos_embed="learned",
+                  pos_embed_offset=2, mlp_type="mlp", use_bias=True,
+                  rms_norm_eps=eps,
+                  activation=_map_activation(
+                      hf.get("activation_function", "relu")))
+        if not hf.get("do_layer_norm_before", True):
+            raise ValueError("post-layernorm OPT (do_layer_norm_before="
+                             "False, 125m/350m) is not supported")
+    elif mt == "bloom":
+        d = hf.get("hidden_size", hf.get("n_embed", 1024))
+        kw = dict(vocab_size=hf.get("vocab_size", 250880), hidden_size=d,
+                  intermediate_size=4 * d,
+                  num_layers=hf.get("n_layer",
+                                    hf.get("num_hidden_layers", 24)),
+                  num_heads=hf.get("n_head",
+                                   hf.get("num_attention_heads", 16)),
+                  max_seq_len=2048,
+                  tie_embeddings=True, norm_type="layernorm",
+                  pos_embed="alibi", mlp_type="mlp", use_bias=True,
+                  embed_norm=True, rms_norm_eps=eps, activation="gelu")
+    elif mt == "falcon":
+        if hf.get("new_decoder_architecture", False):
+            raise ValueError("falcon new_decoder_architecture (40b/180b "
+                             "grouped-qkv interleave) is not supported yet")
+        d = hf.get("hidden_size", 4544)
+        n = hf.get("num_attention_heads", hf.get("n_head", 71))
+        kw = dict(vocab_size=hf.get("vocab_size", 65024), hidden_size=d,
+                  intermediate_size=4 * d,
+                  num_layers=hf.get("num_hidden_layers",
+                                    hf.get("n_layer", 32)),
+                  num_heads=n,
+                  num_kv_heads=1 if hf.get("multi_query", True) else n,
+                  max_seq_len=2048,
+                  tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
+                  norm_type="layernorm", mlp_type="mlp",
+                  activation="gelu_exact", use_bias=bool(hf.get("bias",
+                                                                False)),
+                  # falcon-rw family: ALiBi instead of RoPE. HF falcon folds
+                  # the softmax scale over the bias too — softmax((qk+alibi)/
+                  # √hd) — unlike bloom, so the effective slopes are /√hd
+                  pos_embed="alibi" if hf.get("alibi") else "rope",
+                  alibi_scale=(1.0 / float(np.sqrt(d // n))
+                               if hf.get("alibi") else 1.0),
+                  parallel_block=bool(hf.get("parallel_attn", True)),
+                  shared_block_norm=bool(hf.get("parallel_attn", True)),
+                  rope_theta=float(hf.get("rope_theta", 10000.0)),
+                  rms_norm_eps=eps)
+    elif mt == "gpt_neox":
+        d = hf.get("hidden_size", 6144)
+        kw = dict(vocab_size=hf.get("vocab_size", 50432), hidden_size=d,
+                  intermediate_size=hf.get("intermediate_size", 4 * d),
+                  num_layers=hf.get("num_hidden_layers", 44),
+                  num_heads=hf.get("num_attention_heads", 64),
+                  max_seq_len=hf.get("max_position_embeddings", 2048),
+                  tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+                  norm_type="layernorm", mlp_type="mlp", use_bias=True,
+                  rotary_pct=float(hf.get("rotary_pct", 0.25)),
+                  parallel_block=bool(hf.get("use_parallel_residual", True)),
+                  rope_theta=float(hf.get("rotary_emb_base", 10000.0)),
+                  rms_norm_eps=eps,
+                  activation=_map_activation(hf.get("hidden_act", "gelu")))
+    elif mt == "gptj":
+        d = hf.get("n_embd", 4096)
+        nh = hf.get("n_head", 16)
+        kw = dict(vocab_size=hf.get("vocab_size", 50400), hidden_size=d,
+                  intermediate_size=hf.get("n_inner") or 4 * d,
+                  num_layers=hf.get("n_layer", 28), num_heads=nh,
+                  max_seq_len=hf.get("n_positions", 2048),
+                  tie_embeddings=False, norm_type="layernorm",
+                  mlp_type="mlp", use_bias=True, qkv_bias=False,
+                  attn_out_bias=False, lm_head_bias=True,
+                  rotary_pct=hf.get("rotary_dim", 64) / (d // nh),
+                  parallel_block=True, shared_block_norm=True,
+                  rms_norm_eps=eps,
+                  activation=_map_activation(
+                      hf.get("activation_function", "gelu_new")))
+    elif mt == "phi":
+        d = hf.get("hidden_size", 2560)
+        kw = dict(vocab_size=hf.get("vocab_size", 51200), hidden_size=d,
+                  intermediate_size=hf.get("intermediate_size", 4 * d),
+                  num_layers=hf.get("num_hidden_layers", 32),
+                  num_heads=hf.get("num_attention_heads", 32),
+                  num_kv_heads=hf.get("num_key_value_heads") or
+                  hf.get("num_attention_heads", 32),
+                  max_seq_len=hf.get("max_position_embeddings", 2048),
+                  tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+                  norm_type="layernorm", mlp_type="mlp", use_bias=True,
+                  lm_head_bias=True,
+                  rotary_pct=float(hf.get("partial_rotary_factor", 0.5)),
+                  parallel_block=True, shared_block_norm=True,
+                  rope_theta=float(hf.get("rope_theta", 10000.0)),
+                  rms_norm_eps=eps,
+                  activation=_map_activation(hf.get("hidden_act",
+                                                    "gelu_new")))
+    else:
+        # Llama / Mistral / Mixtral / Qwen2 family (the original map)
+        kw = dict(
+            vocab_size=hf.get("vocab_size", 32000),
+            hidden_size=hf.get("hidden_size", 4096),
+            intermediate_size=hf.get("intermediate_size", 11008),
+            num_layers=hf.get("num_hidden_layers", 32),
+            num_heads=hf.get("num_attention_heads", 32),
+            num_kv_heads=hf.get("num_key_value_heads",
+                                hf.get("num_attention_heads", 32)),
+            head_dim=hf.get("head_dim"),
+            max_seq_len=hf.get("max_position_embeddings", 4096),
+            rope_theta=float(hf.get("rope_theta", 10000.0)),
+            rms_norm_eps=eps,
+            tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+            activation=_map_activation(hf.get("hidden_act", "silu")),
+        )
+        if hf.get("sliding_window") and hf.get("use_sliding_window", True):
+            kw["sliding_window"] = int(hf["sliding_window"])
+        if mt == "qwen2":
+            kw["qkv_bias"] = True
+        if mt == "mixtral" or "num_local_experts" in hf:
+            kw.update(num_experts=hf.get("num_local_experts", 8),
+                      num_experts_per_tok=hf.get("num_experts_per_tok", 2),
+                      aux_loss_coef=float(hf.get("router_aux_loss_coef",
+                                                 0.01)))
     kw.update(overrides)
     return ModelConfig(**kw)
 
@@ -121,7 +254,19 @@ class HFCheckpointSource:
         return self._name_to_file.keys()
 
     def __contains__(self, name: str) -> bool:
-        return name in self._name_to_file
+        return self.resolve(name) is not None
+
+    def resolve(self, name: str) -> Optional[str]:
+        """Checkpoint name variants: some exports carry/drop the top-level
+        module prefix (``transformer.``/``model.``/``gpt_neox.``)."""
+        if name in self._name_to_file:
+            return name
+        for pre in ("transformer.", "model.", "gpt_neox."):
+            if name.startswith(pre) and name[len(pre):] in self._name_to_file:
+                return name[len(pre):]
+            if pre + name in self._name_to_file:
+                return pre + name
+        return None
 
     def _load_bin(self, fname: str) -> Dict[str, Any]:
         if fname not in self._bin_cache:
@@ -134,15 +279,19 @@ class HFCheckpointSource:
 
     def get(self, name: str) -> np.ndarray:
         """One tensor as numpy (bf16 arrives as ml_dtypes.bfloat16)."""
-        fname = self._name_to_file[name]
+        resolved = self.resolve(name)
+        if resolved is None:
+            raise KeyError(f"tensor {name!r} not in checkpoint "
+                           f"(have e.g. {list(self.names)[:4]}...)")
+        fname = self._name_to_file[resolved]
         if self._use_safetensors:
             if fname not in self._safe_handles:
                 from safetensors import safe_open
 
                 self._safe_handles[fname] = safe_open(
                     os.path.join(self.path, fname), framework="numpy")
-            return self._safe_handles[fname].get_tensor(name)
-        t = self._load_bin(fname)[name]
+            return self._safe_handles[fname].get_tensor(resolved)
+        t = self._load_bin(fname)[resolved]
         if str(t.dtype) == "torch.bfloat16":
             import ml_dtypes
 
@@ -155,26 +304,366 @@ class HFCheckpointSource:
         self._bin_cache.clear()
 
 
-# ----------------------------------------------------------------- name map
-def _hf_layer_map(i: int, moe: bool) -> Dict[str, Tuple[Tuple[str, ...], bool]]:
-    """HF name → (pytree path under the layer, transpose?) for layer ``i``."""
-    pre = f"model.layers.{i}."
-    m = {
-        pre + "input_layernorm.weight": (("attn_norm", "scale"), False),
-        pre + "self_attn.q_proj.weight": (("attn", "wq"), True),
-        pre + "self_attn.k_proj.weight": (("attn", "wk"), True),
-        pre + "self_attn.v_proj.weight": (("attn", "wv"), True),
-        pre + "self_attn.o_proj.weight": (("attn", "wo"), True),
-        pre + "post_attention_layernorm.weight": (("mlp_norm", "scale"), False),
-    }
-    if moe:
-        m[pre + "block_sparse_moe.gate.weight"] = (("moe", "router"), True)
-        # expert weights handled specially (stacked over the expert dim)
-    else:
-        m[pre + "mlp.gate_proj.weight"] = (("mlp", "w_gate"), True)
-        m[pre + "mlp.up_proj.weight"] = (("mlp", "w_up"), True)
-        m[pre + "mlp.down_proj.weight"] = (("mlp", "w_down"), True)
+# ------------------------------------------------------------------ transforms
+def _t(a: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(a.T)
+
+
+def _id(a: np.ndarray) -> np.ndarray:
+    return a
+
+
+def _cols(lo: int, hi: int) -> Callable:
+    """Slice columns of an already-[in, out] matrix (GPT-2 Conv1D fused qkv)."""
+    return lambda a: np.ascontiguousarray(a[..., lo:hi])
+
+
+def _fused3(idx: int, heads: int, head_dim: int) -> Callable:
+    """BLOOM/NeoX fused qkv: weight [(H·3·hd), d] laid out [H, 3, hd] on the
+    out dim → component ``idx`` as [d, H·hd]; bias [(H·3·hd)] → [H·hd]."""
+    def f(a: np.ndarray) -> np.ndarray:
+        if a.ndim == 2:
+            w = a.reshape(heads, 3, head_dim, a.shape[1])[:, idx]
+            return _t(w.reshape(heads * head_dim, a.shape[1]))
+        return np.ascontiguousarray(
+            a.reshape(heads, 3, head_dim)[:, idx].reshape(-1))
+    return f
+
+
+def _rows(lo: int, hi: int) -> Callable:
+    """Row-slice of a torch [out, in] matrix then transpose (Falcon concat
+    fused qkv: q rows, then k rows, then v rows)."""
+    return lambda a: _t(a[lo:hi])
+
+
+def _rotary_interleaved_to_half(heads: int, head_dim: int,
+                                rotary_dim: int) -> Callable:
+    """GPT-J stores rotary dims interleaved (pairs (0,1),(2,3),…); our
+    :func:`models.layers.apply_rope` uses the split-half convention (pairs
+    (i, i+rd/2)). Attention is invariant under a consistent permutation of
+    q/k feature columns, so permuting the weight columns at load time makes
+    the two conventions produce identical logits."""
+    perm = np.concatenate([np.arange(0, rotary_dim, 2),
+                           np.arange(1, rotary_dim, 2),
+                           np.arange(rotary_dim, head_dim)])
+
+    def f(a: np.ndarray) -> np.ndarray:
+        w = _t(a)  # [d, H·hd]
+        w = w.reshape(w.shape[0], heads, head_dim)[:, :, perm]
+        return np.ascontiguousarray(w.reshape(w.shape[0], -1))
+    return f
+
+
+# ----------------------------------------------------------------- leaf maps
+def _norm_leaves(segs: Tuple[str, ...], hf_base: str, cfg: ModelConfig):
+    m = {segs + ("scale",): (hf_base + ".weight", _id)}
+    if cfg.norm_type == "layernorm":
+        m[segs + ("bias",)] = (hf_base + ".bias", _id)
     return m
+
+
+def _family_llama(cfg: ModelConfig):
+    def top():
+        m = {("embed", "embedding"): ("model.embed_tokens.weight", _id)}
+        m.update(_norm_leaves(("final_norm",), "model.norm", cfg))
+        if not cfg.tie_embeddings:
+            m[("lm_head", "kernel")] = ("lm_head.weight", _t)
+        return m
+
+    def layer(i: int):
+        pre = f"model.layers.{i}."
+        m = {
+            ("attn", "wq"): (pre + "self_attn.q_proj.weight", _t),
+            ("attn", "wk"): (pre + "self_attn.k_proj.weight", _t),
+            ("attn", "wv"): (pre + "self_attn.v_proj.weight", _t),
+            ("attn", "wo"): (pre + "self_attn.o_proj.weight", _t),
+        }
+        if cfg.qkv_bias:  # qwen2
+            m[("attn", "bq")] = (pre + "self_attn.q_proj.bias", _id)
+            m[("attn", "bk")] = (pre + "self_attn.k_proj.bias", _id)
+            m[("attn", "bv")] = (pre + "self_attn.v_proj.bias", _id)
+        m.update(_norm_leaves(("attn_norm",), pre + "input_layernorm", cfg))
+        m.update(_norm_leaves(("mlp_norm",), pre + "post_attention_layernorm",
+                              cfg))
+        if cfg.any_moe:
+            m[("moe", "router")] = (pre + "block_sparse_moe.gate.weight", _t)
+        else:
+            m[("mlp", "w_gate")] = (pre + "mlp.gate_proj.weight", _t)
+            m[("mlp", "w_up")] = (pre + "mlp.up_proj.weight", _t)
+            m[("mlp", "w_down")] = (pre + "mlp.down_proj.weight", _t)
+        return m
+
+    return top, layer
+
+
+def _family_gpt2(cfg: ModelConfig):
+    d = cfg.hidden_size
+
+    def top():
+        m = {("embed", "embedding"): ("transformer.wte.weight", _id),
+             ("pos_embed", "embedding"): ("transformer.wpe.weight", _id)}
+        m.update(_norm_leaves(("final_norm",), "transformer.ln_f", cfg))
+        return m
+
+    def layer(i: int):
+        pre = f"transformer.h.{i}."
+        m = {
+            # Conv1D already stores [in, out]: slice fused qkv columns
+            ("attn", "wq"): (pre + "attn.c_attn.weight", _cols(0, d)),
+            ("attn", "wk"): (pre + "attn.c_attn.weight", _cols(d, 2 * d)),
+            ("attn", "wv"): (pre + "attn.c_attn.weight", _cols(2 * d, 3 * d)),
+            ("attn", "bq"): (pre + "attn.c_attn.bias", _cols(0, d)),
+            ("attn", "bk"): (pre + "attn.c_attn.bias", _cols(d, 2 * d)),
+            ("attn", "bv"): (pre + "attn.c_attn.bias", _cols(2 * d, 3 * d)),
+            ("attn", "wo"): (pre + "attn.c_proj.weight", _id),
+            ("attn", "bo"): (pre + "attn.c_proj.bias", _id),
+            ("mlp", "fc1"): (pre + "mlp.c_fc.weight", _id),
+            ("mlp", "b1"): (pre + "mlp.c_fc.bias", _id),
+            ("mlp", "fc2"): (pre + "mlp.c_proj.weight", _id),
+            ("mlp", "b2"): (pre + "mlp.c_proj.bias", _id),
+        }
+        m.update(_norm_leaves(("attn_norm",), pre + "ln_1", cfg))
+        m.update(_norm_leaves(("mlp_norm",), pre + "ln_2", cfg))
+        return m
+
+    return top, layer
+
+
+def _family_opt(cfg: ModelConfig):
+    def top():
+        m = {("embed", "embedding"): ("model.decoder.embed_tokens.weight",
+                                      _id),
+             ("pos_embed", "embedding"): (
+                 "model.decoder.embed_positions.weight", _id)}
+        m.update(_norm_leaves(("final_norm",),
+                              "model.decoder.final_layer_norm", cfg))
+        if not cfg.tie_embeddings:
+            m[("lm_head", "kernel")] = ("lm_head.weight", _t)
+        return m
+
+    def layer(i: int):
+        pre = f"model.decoder.layers.{i}."
+        m = {
+            ("attn", "wq"): (pre + "self_attn.q_proj.weight", _t),
+            ("attn", "bq"): (pre + "self_attn.q_proj.bias", _id),
+            ("attn", "wk"): (pre + "self_attn.k_proj.weight", _t),
+            ("attn", "bk"): (pre + "self_attn.k_proj.bias", _id),
+            ("attn", "wv"): (pre + "self_attn.v_proj.weight", _t),
+            ("attn", "bv"): (pre + "self_attn.v_proj.bias", _id),
+            ("attn", "wo"): (pre + "self_attn.out_proj.weight", _t),
+            ("attn", "bo"): (pre + "self_attn.out_proj.bias", _id),
+            ("mlp", "fc1"): (pre + "fc1.weight", _t),
+            ("mlp", "b1"): (pre + "fc1.bias", _id),
+            ("mlp", "fc2"): (pre + "fc2.weight", _t),
+            ("mlp", "b2"): (pre + "fc2.bias", _id),
+        }
+        m.update(_norm_leaves(("attn_norm",), pre + "self_attn_layer_norm",
+                              cfg))
+        m.update(_norm_leaves(("mlp_norm",), pre + "final_layer_norm", cfg))
+        return m
+
+    return top, layer
+
+
+def _family_bloom(cfg: ModelConfig):
+    n, hd = cfg.num_heads, cfg.head_dim
+
+    def top():
+        m = {("embed", "embedding"): ("transformer.word_embeddings.weight",
+                                      _id)}
+        m.update(_norm_leaves(("embed_norm",),
+                              "transformer.word_embeddings_layernorm", cfg))
+        m.update(_norm_leaves(("final_norm",), "transformer.ln_f", cfg))
+        return m
+
+    def layer(i: int):
+        pre = f"transformer.h.{i}."
+        qkv_w = pre + "self_attention.query_key_value.weight"
+        qkv_b = pre + "self_attention.query_key_value.bias"
+        m = {
+            ("attn", "wq"): (qkv_w, _fused3(0, n, hd)),
+            ("attn", "wk"): (qkv_w, _fused3(1, n, hd)),
+            ("attn", "wv"): (qkv_w, _fused3(2, n, hd)),
+            ("attn", "bq"): (qkv_b, _fused3(0, n, hd)),
+            ("attn", "bk"): (qkv_b, _fused3(1, n, hd)),
+            ("attn", "bv"): (qkv_b, _fused3(2, n, hd)),
+            ("attn", "wo"): (pre + "self_attention.dense.weight", _t),
+            ("attn", "bo"): (pre + "self_attention.dense.bias", _id),
+            ("mlp", "fc1"): (pre + "mlp.dense_h_to_4h.weight", _t),
+            ("mlp", "b1"): (pre + "mlp.dense_h_to_4h.bias", _id),
+            ("mlp", "fc2"): (pre + "mlp.dense_4h_to_h.weight", _t),
+            ("mlp", "b2"): (pre + "mlp.dense_4h_to_h.bias", _id),
+        }
+        m.update(_norm_leaves(("attn_norm",), pre + "input_layernorm", cfg))
+        m.update(_norm_leaves(("mlp_norm",), pre + "post_attention_layernorm",
+                              cfg))
+        return m
+
+    return top, layer
+
+
+def _family_falcon(cfg: ModelConfig):
+    n, kv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    def top():
+        m = {("embed", "embedding"): ("transformer.word_embeddings.weight",
+                                      _id)}
+        m.update(_norm_leaves(("final_norm",), "transformer.ln_f", cfg))
+        if not cfg.tie_embeddings:
+            m[("lm_head", "kernel")] = ("lm_head.weight", _t)
+        return m
+
+    def layer(i: int):
+        pre = f"transformer.h.{i}."
+        qkv = pre + "self_attention.query_key_value.weight"
+        if kv == 1:
+            # multi-query layout: q rows [n·hd], then k [kv·hd], then v
+            q_fn = _rows(0, n * hd)
+            k_fn = _rows(n * hd, (n + kv) * hd)
+            v_fn = _rows((n + kv) * hd, (n + 2 * kv) * hd)
+        else:
+            # falcon-rw (multi_query=False): per-head [H, 3, hd] interleave
+            q_fn, k_fn, v_fn = (_fused3(0, n, hd), _fused3(1, n, hd),
+                                _fused3(2, n, hd))
+        m = {
+            ("attn", "wq"): (qkv, q_fn),
+            ("attn", "wk"): (qkv, k_fn),
+            ("attn", "wv"): (qkv, v_fn),
+            ("attn", "wo"): (pre + "self_attention.dense.weight", _t),
+            ("mlp", "fc1"): (pre + "mlp.dense_h_to_4h.weight", _t),
+            ("mlp", "fc2"): (pre + "mlp.dense_4h_to_h.weight", _t),
+        }
+        if cfg.use_bias:
+            qkv_b = pre + "self_attention.query_key_value.bias"
+            if kv == 1:
+                m[("attn", "bq")] = (qkv_b, lambda a: a[:n * hd])
+                m[("attn", "bk")] = (qkv_b,
+                                     lambda a: a[n * hd:(n + kv) * hd])
+                m[("attn", "bv")] = (qkv_b,
+                                     lambda a: a[(n + kv) * hd:])
+            else:
+                m[("attn", "bq")] = (qkv_b, _fused3(0, n, hd))
+                m[("attn", "bk")] = (qkv_b, _fused3(1, n, hd))
+                m[("attn", "bv")] = (qkv_b, _fused3(2, n, hd))
+            m[("attn", "bo")] = (pre + "self_attention.dense.bias", _id)
+            m[("mlp", "b1")] = (pre + "mlp.dense_h_to_4h.bias", _id)
+            m[("mlp", "b2")] = (pre + "mlp.dense_4h_to_h.bias", _id)
+        m.update(_norm_leaves(("attn_norm",), pre + "input_layernorm", cfg))
+        if not cfg.shared_block_norm:
+            m.update(_norm_leaves(("mlp_norm",), pre + "post_attention_"
+                                  "layernorm", cfg))
+        return m
+
+    return top, layer
+
+
+def _family_gpt_neox(cfg: ModelConfig):
+    n, hd = cfg.num_heads, cfg.head_dim
+
+    def top():
+        m = {("embed", "embedding"): ("gpt_neox.embed_in.weight", _id)}
+        m.update(_norm_leaves(("final_norm",), "gpt_neox.final_layer_norm",
+                              cfg))
+        if not cfg.tie_embeddings:
+            m[("lm_head", "kernel")] = ("embed_out.weight", _t)
+        return m
+
+    def layer(i: int):
+        pre = f"gpt_neox.layers.{i}."
+        qkv_w = pre + "attention.query_key_value.weight"
+        qkv_b = pre + "attention.query_key_value.bias"
+        m = {
+            ("attn", "wq"): (qkv_w, _fused3(0, n, hd)),
+            ("attn", "wk"): (qkv_w, _fused3(1, n, hd)),
+            ("attn", "wv"): (qkv_w, _fused3(2, n, hd)),
+            ("attn", "bq"): (qkv_b, _fused3(0, n, hd)),
+            ("attn", "bk"): (qkv_b, _fused3(1, n, hd)),
+            ("attn", "bv"): (qkv_b, _fused3(2, n, hd)),
+            ("attn", "wo"): (pre + "attention.dense.weight", _t),
+            ("attn", "bo"): (pre + "attention.dense.bias", _id),
+            ("mlp", "fc1"): (pre + "mlp.dense_h_to_4h.weight", _t),
+            ("mlp", "b1"): (pre + "mlp.dense_h_to_4h.bias", _id),
+            ("mlp", "fc2"): (pre + "mlp.dense_4h_to_h.weight", _t),
+            ("mlp", "b2"): (pre + "mlp.dense_4h_to_h.bias", _id),
+        }
+        m.update(_norm_leaves(("attn_norm",), pre + "input_layernorm", cfg))
+        m.update(_norm_leaves(("mlp_norm",), pre + "post_attention_layernorm",
+                              cfg))
+        return m
+
+    return top, layer
+
+
+def _family_gptj(cfg: ModelConfig):
+    n, hd, rd = cfg.num_heads, cfg.head_dim, cfg.rotary_dim
+    rot = _rotary_interleaved_to_half(n, hd, rd)
+
+    def top():
+        m = {("embed", "embedding"): ("transformer.wte.weight", _id)}
+        if not cfg.tie_embeddings:
+            m[("lm_head", "kernel")] = ("lm_head.weight", _t)
+            if cfg.lm_head_bias:
+                m[("lm_head", "bias")] = ("lm_head.bias", _id)
+        m.update(_norm_leaves(("final_norm",), "transformer.ln_f", cfg))
+        return m
+
+    def layer(i: int):
+        pre = f"transformer.h.{i}."
+        m = {
+            ("attn", "wq"): (pre + "attn.q_proj.weight", rot),
+            ("attn", "wk"): (pre + "attn.k_proj.weight", rot),
+            ("attn", "wv"): (pre + "attn.v_proj.weight", _t),
+            ("attn", "wo"): (pre + "attn.out_proj.weight", _t),
+            ("mlp", "fc1"): (pre + "mlp.fc_in.weight", _t),
+            ("mlp", "b1"): (pre + "mlp.fc_in.bias", _id),
+            ("mlp", "fc2"): (pre + "mlp.fc_out.weight", _t),
+            ("mlp", "b2"): (pre + "mlp.fc_out.bias", _id),
+        }
+        m.update(_norm_leaves(("attn_norm",), pre + "ln_1", cfg))
+        return m
+
+    return top, layer
+
+
+def _family_phi(cfg: ModelConfig):
+    def top():
+        m = {("embed", "embedding"): ("model.embed_tokens.weight", _id)}
+        if not cfg.tie_embeddings:
+            m[("lm_head", "kernel")] = ("lm_head.weight", _t)
+            if cfg.lm_head_bias:
+                m[("lm_head", "bias")] = ("lm_head.bias", _id)
+        m.update(_norm_leaves(("final_norm",), "model.final_layernorm", cfg))
+        return m
+
+    def layer(i: int):
+        pre = f"model.layers.{i}."
+        m = {
+            ("attn", "wq"): (pre + "self_attn.q_proj.weight", _t),
+            ("attn", "bq"): (pre + "self_attn.q_proj.bias", _id),
+            ("attn", "wk"): (pre + "self_attn.k_proj.weight", _t),
+            ("attn", "bk"): (pre + "self_attn.k_proj.bias", _id),
+            ("attn", "wv"): (pre + "self_attn.v_proj.weight", _t),
+            ("attn", "bv"): (pre + "self_attn.v_proj.bias", _id),
+            ("attn", "wo"): (pre + "self_attn.dense.weight", _t),
+            ("attn", "bo"): (pre + "self_attn.dense.bias", _id),
+            ("mlp", "fc1"): (pre + "mlp.fc1.weight", _t),
+            ("mlp", "b1"): (pre + "mlp.fc1.bias", _id),
+            ("mlp", "fc2"): (pre + "mlp.fc2.weight", _t),
+            ("mlp", "b2"): (pre + "mlp.fc2.bias", _id),
+        }
+        m.update(_norm_leaves(("attn_norm",), pre + "input_layernorm", cfg))
+        return m
+
+    return top, layer
+
+
+FAMILIES = {
+    "llama": _family_llama, "mistral": _family_llama,
+    "mixtral": _family_llama, "qwen2": _family_llama,
+    "gpt2": _family_gpt2, "opt": _family_opt, "bloom": _family_bloom,
+    "falcon": _family_falcon, "gpt_neox": _family_gpt_neox,
+    "gptj": _family_gptj, "phi": _family_phi,
+}
 
 
 def _expert_names(i: int, e: int) -> Dict[str, Tuple[str, bool]]:
@@ -228,6 +717,13 @@ def load_hf_checkpoint(path: str,
     cfg = model.config
     model.hf_config = hf_cfg
 
+    mt = hf_cfg.get("model_type", "llama")
+    if mt not in FAMILIES:
+        logger.warning(f"model_type {mt!r} unknown — using the llama-family "
+                       f"name map")
+        mt = "llama"
+    top_map_fn, layer_map_fn = FAMILIES[mt](cfg)
+
     src = HFCheckpointSource(path)
     shard_leaves: Dict[str, Any] = {}
     if shardings is not None:
@@ -239,33 +735,27 @@ def load_hf_checkpoint(path: str,
     def sharding_for(*segs) -> Any:
         return shard_leaves.get("/".join(segs))
 
-    def fetch(name: str, transpose: bool) -> np.ndarray:
-        arr = src.get(name)
-        return np.ascontiguousarray(arr.T) if transpose else arr
-
     params: Dict[str, Any] = {}
+
+    def emit_into(tree, segs, val):
+        d = tree
+        for s in segs[:-1]:
+            d = d.setdefault(s, {})
+        d[segs[-1]] = val
+
     # ---- top-level leaves
-    params["embed"] = {"embedding": _put(
-        fetch("model.embed_tokens.weight", False),
-        sharding_for("embed", "embedding"), dtype)}
-    params["final_norm"] = {"scale": _put(
-        fetch("model.norm.weight", False),
-        sharding_for("final_norm", "scale"), dtype)}
-    if not cfg.tie_embeddings:
-        if "lm_head.weight" in src:
-            head = fetch("lm_head.weight", True)
-        else:  # tied on disk but untied config: reuse the embedding
-            head = np.ascontiguousarray(
-                src.get("model.embed_tokens.weight").T)
-        params["lm_head"] = {"kernel": _put(
-            head, sharding_for("lm_head", "kernel"), dtype)}
+    for segs, (name, fn) in top_map_fn().items():
+        if segs == ("lm_head", "kernel") and name not in src:
+            # tied on disk but untied config: reuse the embedding
+            emb_name = top_map_fn()[("embed", "embedding")][0]
+            arr = _t(src.get(emb_name))
+        else:
+            arr = fn(src.get(name))
+        emit_into(params, segs, _put(arr, sharding_for(*segs), dtype))
 
     # ---- per-layer leaves, assembled stacked (scan) or as a list.
     # models/transformer.py applies MoE uniformly when cfg.any_moe (scan
     # requires homogeneous layers), so the map mirrors that.
-    def is_moe_layer(i: int) -> bool:
-        return cfg.any_moe
-
     def assemble_stacked() -> Dict[str, Any]:
         """One stacked leaf at a time: fill its [L, ...] host buffer across
         layers, device_put, free — peak host memory is one leaf, never the
@@ -273,41 +763,34 @@ def load_hf_checkpoint(path: str,
         I/O passes through any one file region)."""
         L = cfg.num_layers
         out: Dict[str, Any] = {}
-
-        def emit(segs: Tuple[str, ...], buf: np.ndarray):
-            d = out
-            for s in segs[:-1]:
-                d = d.setdefault(s, {})
-            d[segs[-1]] = _put(buf, sharding_for("layers", *segs), dtype)
-
-        # invert the per-layer map: leaf path → per-layer HF name
-        layer0 = _hf_layer_map(0, is_moe_layer(0))
-        for name0, (segs, tr) in layer0.items():
-            p0 = fetch(name0, tr)
+        layer0 = layer_map_fn(0)
+        for segs, (name0, fn0) in layer0.items():
+            p0 = fn0(src.get(name0))
             buf = np.empty((L,) + p0.shape, p0.dtype)
             buf[0] = p0
             for i in range(1, L):
-                name_i = {n: k for n, (k, _) in
-                          _hf_layer_map(i, is_moe_layer(i)).items()}
-                hf_name = next(n for n, k in name_i.items() if k == segs)
-                buf[i] = fetch(hf_name, tr)
-            emit(segs, buf)
+                name_i, fn_i = layer_map_fn(i)[segs]
+                buf[i] = fn_i(src.get(name_i))
+            emit_into(out, segs, _put(buf, sharding_for("layers", *segs),
+                                      dtype))
             del buf
         if cfg.any_moe:
             E = cfg.num_experts
             for key in ("w_gate", "w_up", "w_down"):
-                p0 = None
                 buf = None
                 for i in range(L):
                     for e in range(E):
                         name, (_, tr) = next(
                             (n, v) for n, v in _expert_names(i, e).items()
                             if v[0] == key)
-                        p = fetch(name, tr)
+                        p = src.get(name)
+                        p = _t(p) if tr else p
                         if buf is None:
                             buf = np.empty((L, E) + p.shape, p.dtype)
                         buf[i, e] = p
-                emit(("moe", key), buf)
+                emit_into(out, ("moe", key),
+                          _put(buf, sharding_for("layers", "moe", key),
+                               dtype))
                 del buf
         return out
 
@@ -315,17 +798,17 @@ def load_hf_checkpoint(path: str,
         layers = []
         for i in range(cfg.num_layers):
             lp: Dict[str, Any] = {}
-            for name, (segs, tr) in _hf_layer_map(i, is_moe_layer(i)).items():
-                d = lp
-                for s in segs[:-1]:
-                    d = d.setdefault(s, {})
-                d[segs[-1]] = _put(fetch(name, tr),
-                                   sharding_for("layers", str(i), *segs), dtype)
-            if is_moe_layer(i):
+            for segs, (name, fn) in layer_map_fn(i).items():
+                emit_into(lp, segs, _put(fn(src.get(name)),
+                                         sharding_for("layers", str(i),
+                                                      *segs), dtype))
+            if cfg.any_moe:
                 stacked: Dict[str, list] = {}
                 for e in range(cfg.num_experts):
                     for name, (key, tr) in _expert_names(i, e).items():
-                        stacked.setdefault(key, []).append(fetch(name, tr))
+                        arr = src.get(name)
+                        stacked.setdefault(key, []).append(
+                            _t(arr) if tr else arr)
                 for key, mats in stacked.items():
                     lp.setdefault("moe", {})[key] = _put(
                         np.stack(mats), sharding_for("layers", str(i), "moe",
@@ -337,6 +820,6 @@ def load_hf_checkpoint(path: str,
     src.close()
     n = sum(int(np.prod(np.shape(p)))
             for p in jax.tree_util.tree_leaves(params))
-    log_dist(f"loaded HF checkpoint {path}: {n/1e6:.1f}M params "
+    log_dist(f"loaded HF checkpoint {path} ({mt}): {n/1e6:.1f}M params "
              f"({'safetensors' if src._use_safetensors else 'torch bins'})")
     return model, params

@@ -86,7 +86,7 @@ def _positionize(cfg, q, k, positions):
 
 
 def _arch_bias(cfg):
-    ab = (jnp.asarray(alibi_slopes(cfg.num_heads))
+    ab = (jnp.asarray(alibi_slopes(cfg.num_heads) * cfg.alibi_scale)
           if cfg.pos_embed == "alibi" else None)
     return ab, cfg.sliding_window
 
@@ -108,8 +108,11 @@ def _unembed(params, x, cfg):
     if cfg.tie_embeddings:
         return jnp.einsum("sd,vd->sv", x,
                           params["embed"]["embedding"].astype(x.dtype))
-    return jnp.einsum("sd,dv->sv", x,
-                      params["lm_head"]["kernel"].astype(x.dtype))
+    logits = jnp.einsum("sd,dv->sv", x,
+                        params["lm_head"]["kernel"].astype(x.dtype))
+    if cfg.lm_head_bias:
+        logits = logits + params["lm_head"]["bias"].astype(logits.dtype)
+    return logits
 
 
 def _block(cfg, p, x, attn_fn):

@@ -35,8 +35,10 @@ class ModelConfig:
     use_bias: bool = False     # biases on attention/MLP projections
     qkv_bias: Optional[bool] = None  # override bias for q/k/v only (Qwen-style)
     attn_out_bias: Optional[bool] = None  # override bias for attn out proj (gptj)
+    lm_head_bias: bool = False      # bias on the unembedding (gptj/phi)
     norm_type: str = "rmsnorm"      # rmsnorm | layernorm (learned bias)
     pos_embed: str = "rope"         # rope | learned | alibi | none
+    alibi_scale: float = 1.0        # falcon-rw divides alibi by sqrt(head_dim)
     pos_embed_offset: int = 0       # OPT stores positions at offset 2
     rotary_pct: float = 1.0         # partial rotary (gpt-neox 0.25, phi 0.4)
     mlp_type: str = "glu"           # glu (gated, 3 mats) | mlp (fc1/fc2)
@@ -174,7 +176,7 @@ PRESETS = {
                 num_layers=32, num_heads=32, max_seq_len=2048,
                 norm_type="layernorm", mlp_type="mlp", activation="gelu",
                 use_bias=True, rotary_pct=0.4, parallel_block=True,
-                shared_block_norm=True),
+                shared_block_norm=True, lm_head_bias=True),
     "gpt-neox-20b": _p(vocab_size=50432, hidden_size=6144, intermediate_size=24576,
                        num_layers=44, num_heads=64, max_seq_len=2048,
                        norm_type="layernorm", mlp_type="mlp",
@@ -184,7 +186,8 @@ PRESETS = {
                   num_layers=28, num_heads=16, max_seq_len=2048,
                   norm_type="layernorm", mlp_type="mlp", activation="gelu",
                   use_bias=True, qkv_bias=False, attn_out_bias=False,
-                  rotary_pct=0.25, parallel_block=True, shared_block_norm=True),
+                  rotary_pct=0.25, parallel_block=True, shared_block_norm=True,
+                  lm_head_bias=True),
     # Llama-2 family (FastGen/ZeRO baselines; blogs/deepspeed-fastgen/README.md:135)
     "llama2-1b": _p(vocab_size=32000, hidden_size=2048, intermediate_size=5504,
                     num_layers=16, num_heads=16, num_kv_heads=16, max_seq_len=4096),

@@ -326,7 +326,7 @@ def attention_block(p: Params, x: jnp.ndarray, cfg: ModelConfig,
     if cfg.pos_embed == "rope":
         q = apply_rope(q, positions, cfg.rope_theta, cfg.rotary_dim)
         k = apply_rope(k, positions, cfg.rope_theta, cfg.rotary_dim)
-    alibi = (jnp.asarray(alibi_slopes(cfg.num_heads))
+    alibi = (jnp.asarray(alibi_slopes(cfg.num_heads) * cfg.alibi_scale)
              if cfg.pos_embed == "alibi" else None)
     window = cfg.sliding_window
 

@@ -130,6 +130,9 @@ class CausalLM:
         if not cfg.tie_embeddings:
             params["lm_head"] = {
                 "kernel": dense((cfg.hidden_size, cfg.vocab_size), next(keys))}
+            if cfg.lm_head_bias:
+                params["lm_head"]["bias"] = jnp.zeros((cfg.vocab_size,),
+                                                      jnp.float32)
         return params
 
     # ------------------------------------------------------------------ forward
@@ -301,6 +304,8 @@ class CausalLM:
         else:
             logits = jnp.einsum("bsd,dv->bsv", x,
                                 params["lm_head"]["kernel"].astype(x.dtype))
+            if cfg.lm_head_bias:
+                logits = logits + params["lm_head"]["bias"].astype(logits.dtype)
         return logits.astype(jnp.float32), new_cache, aux_total
 
     def apply(self, params: Params, input_ids: jnp.ndarray, **kw) -> jnp.ndarray:

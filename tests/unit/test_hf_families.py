@@ -1,0 +1,200 @@
+"""HF-architecture ingestion parity: build a tiny random model with the REAL
+HuggingFace implementation of each family, save it in HF format, ingest it
+through ``checkpoint/hf.load_hf_checkpoint``, and demand logits parity against
+the torch forward.
+
+This is the strongest possible check of both the name maps (fused-qkv splits,
+Conv1D orientation, per-head layouts, rotary conventions) and the model math
+(norms, positional schemes, residual forms, biases) — the analog of the
+reference's kernel-vs-torch parity suite applied at whole-model scope
+(SURVEY.md §4; reference per-arch policies:
+``deepspeed/module_inject/containers/*.py``).
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from deepspeedsyclsupport_tpu.checkpoint.hf import load_hf_checkpoint
+
+V, D, L, H, SEQ = 128, 32, 2, 4, 16
+
+
+def _case_llama():
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    return LlamaForCausalLM(LlamaConfig(
+        vocab_size=V, hidden_size=D, intermediate_size=48,
+        num_hidden_layers=L, num_attention_heads=H, num_key_value_heads=2,
+        max_position_embeddings=64))
+
+
+def _case_mistral():
+    from transformers import MistralConfig, MistralForCausalLM
+
+    return MistralForCausalLM(MistralConfig(
+        vocab_size=V, hidden_size=D, intermediate_size=48,
+        num_hidden_layers=L, num_attention_heads=H, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=8))
+
+
+def _case_mixtral():
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    return MixtralForCausalLM(MixtralConfig(
+        vocab_size=V, hidden_size=D, intermediate_size=48,
+        num_hidden_layers=L, num_attention_heads=H, num_key_value_heads=2,
+        max_position_embeddings=64, num_local_experts=4,
+        num_experts_per_tok=2, sliding_window=None))
+
+
+def _case_qwen2():
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    return Qwen2ForCausalLM(Qwen2Config(
+        vocab_size=V, hidden_size=D, intermediate_size=48,
+        num_hidden_layers=L, num_attention_heads=H, num_key_value_heads=2,
+        max_position_embeddings=64, use_sliding_window=False))
+
+
+def _case_gpt2():
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    return GPT2LMHeadModel(GPT2Config(
+        vocab_size=V, n_embd=D, n_layer=L, n_head=H, n_positions=64,
+        n_inner=48, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0))
+
+
+def _case_opt():
+    from transformers import OPTConfig, OPTForCausalLM
+
+    return OPTForCausalLM(OPTConfig(
+        vocab_size=V, hidden_size=D, ffn_dim=48, num_hidden_layers=L,
+        num_attention_heads=H, max_position_embeddings=64,
+        word_embed_proj_dim=D, do_layer_norm_before=True, dropout=0.0))
+
+
+def _case_bloom():
+    from transformers import BloomConfig, BloomForCausalLM
+
+    return BloomForCausalLM(BloomConfig(
+        vocab_size=V, hidden_size=D, n_layer=L, n_head=H,
+        hidden_dropout=0.0, attention_dropout=0.0))
+
+
+def _case_falcon():
+    from transformers import FalconConfig, FalconForCausalLM
+
+    return FalconForCausalLM(FalconConfig(
+        vocab_size=V, hidden_size=D, num_hidden_layers=L,
+        num_attention_heads=H, multi_query=True, parallel_attn=True,
+        bias=False, new_decoder_architecture=False, alibi=False,
+        attention_dropout=0.0, hidden_dropout=0.0))
+
+
+def _case_falcon_rw():
+    from transformers import FalconConfig, FalconForCausalLM
+
+    # falcon-rw-1b family: per-head fused qkv, ALiBi, sequential block, biases
+    return FalconForCausalLM(FalconConfig(
+        vocab_size=V, hidden_size=D, num_hidden_layers=L,
+        num_attention_heads=H, multi_query=False, parallel_attn=False,
+        bias=True, new_decoder_architecture=False, alibi=True,
+        attention_dropout=0.0, hidden_dropout=0.0))
+
+
+def _case_gpt_neox():
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    return GPTNeoXForCausalLM(GPTNeoXConfig(
+        vocab_size=V, hidden_size=D, intermediate_size=48,
+        num_hidden_layers=L, num_attention_heads=H, rotary_pct=0.5,
+        max_position_embeddings=64, use_parallel_residual=True,
+        hidden_dropout=0.0, attention_dropout=0.0))
+
+
+def _case_gptj():
+    from transformers import GPTJConfig, GPTJForCausalLM
+
+    return GPTJForCausalLM(GPTJConfig(
+        vocab_size=V, n_embd=D, n_layer=L, n_head=H, rotary_dim=4,
+        n_positions=64, n_inner=48, resid_pdrop=0.0, embd_pdrop=0.0,
+        attn_pdrop=0.0))
+
+
+def _case_phi():
+    from transformers import PhiConfig, PhiForCausalLM
+
+    return PhiForCausalLM(PhiConfig(
+        vocab_size=V, hidden_size=D, intermediate_size=48,
+        num_hidden_layers=L, num_attention_heads=H,
+        partial_rotary_factor=0.5, max_position_embeddings=64,
+        resid_pdrop=0.0, embd_pdrop=0.0, attention_dropout=0.0))
+
+
+CASES = {
+    "llama": _case_llama, "mistral": _case_mistral, "mixtral": _case_mixtral,
+    "qwen2": _case_qwen2, "gpt2": _case_gpt2, "opt": _case_opt,
+    "bloom": _case_bloom, "falcon": _case_falcon,
+    "falcon_rw": _case_falcon_rw, "gpt_neox": _case_gpt_neox,
+    "gptj": _case_gptj, "phi": _case_phi,
+}
+
+
+@pytest.mark.parametrize("family", sorted(CASES))
+def test_family_logits_parity(family, tmp_path):
+    torch.manual_seed(0)
+    hf_model = CASES[family]()
+    hf_model.eval()
+    hf_model.save_pretrained(tmp_path)
+
+    overrides = {"dtype": "float32"}
+    if family == "mixtral":
+        # parity needs the no-drop expert path semantics: raise capacity so
+        # the training-style capacity einsum never drops tokens
+        overrides["capacity_factor"] = 16.0
+    model, params = load_hf_checkpoint(str(tmp_path),
+                                       config_overrides=overrides)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, V, size=(2, SEQ)).astype(np.int32)
+    ours = np.asarray(model.apply(params, jnp.asarray(ids)))
+    with torch.no_grad():
+        theirs = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+
+    # falcon-rw: HF builds its alibi tensor through bfloat16
+    # (build_alibi_tensor's .bfloat16() cast), so its biases carry bf16
+    # rounding that our fp32 slopes don't reproduce
+    tol = 2e-2 if family == "falcon_rw" else 2e-3
+    np.testing.assert_allclose(ours, theirs, rtol=tol, atol=tol)
+    # and not trivially equal-zero
+    assert float(np.abs(theirs).max()) > 1e-3
+
+
+@pytest.mark.parametrize("family", ["gpt2", "bloom", "gptj"])
+def test_family_greedy_decode_parity(family, tmp_path):
+    """KV-cache greedy decode through OUR engine must reproduce the HF
+    greedy continuation — exercises learned-pos/alibi/rotary-permutation on
+    the incremental path, not just the dense forward."""
+    from deepspeedsyclsupport_tpu.inference import init_inference
+
+    torch.manual_seed(1)
+    hf_model = CASES[family]()
+    hf_model.eval()
+    hf_model.save_pretrained(tmp_path)
+    model, params = load_hf_checkpoint(str(tmp_path),
+                                       config_overrides={"dtype": "float32"})
+
+    prompt = [3, 17, 9, 41]
+    with torch.no_grad():
+        want = hf_model.generate(
+            torch.tensor([prompt], dtype=torch.long), do_sample=False,
+            max_new_tokens=5, pad_token_id=0).numpy()[0, len(prompt):]
+
+    eng = init_inference(model=model, params=params, config={"dtype": "fp32"})
+    got = np.asarray(eng.generate(jnp.asarray([prompt], dtype=jnp.int32),
+                                  max_new_tokens=5))[0]
+    assert list(got) == list(want)
