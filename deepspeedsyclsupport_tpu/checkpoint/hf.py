@@ -99,6 +99,11 @@ def config_from_hf(hf: Dict[str, Any], **overrides) -> ModelConfig:
         if not hf.get("do_layer_norm_before", True):
             raise ValueError("post-layernorm OPT (do_layer_norm_before="
                              "False, 125m/350m) is not supported")
+        wepd = hf.get("word_embed_proj_dim")
+        if wepd is not None and wepd != hf.get("hidden_size"):
+            raise ValueError(
+                f"OPT word_embed_proj_dim={wepd} != hidden_size — the "
+                f"project_in/project_out variant is not supported")
     elif mt == "bloom":
         d = hf.get("hidden_size", hf.get("n_embed", 1024))
         kw = dict(vocab_size=hf.get("vocab_size", 250880), hidden_size=d,
@@ -123,7 +128,7 @@ def config_from_hf(hf: Dict[str, Any], **overrides) -> ModelConfig:
                                     hf.get("n_layer", 32)),
                   num_heads=n,
                   num_kv_heads=1 if hf.get("multi_query", True) else n,
-                  max_seq_len=2048,
+                  max_seq_len=hf.get("max_position_embeddings", 2048),
                   tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
                   norm_type="layernorm", mlp_type="mlp",
                   activation="gelu_exact", use_bias=bool(hf.get("bias",
@@ -666,12 +671,12 @@ FAMILIES = {
 }
 
 
-def _expert_names(i: int, e: int) -> Dict[str, Tuple[str, bool]]:
+def _expert_names(i: int, e: int) -> Dict[str, Tuple[str, Callable]]:
     pre = f"model.layers.{i}.block_sparse_moe.experts.{e}."
     # Mixtral: w1=gate, w3=up, w2=down (reference mixtral container mapping)
-    return {pre + "w1.weight": ("w_gate", True),
-            pre + "w3.weight": ("w_up", True),
-            pre + "w2.weight": ("w_down", True)}
+    return {pre + "w1.weight": ("w_gate", _t),
+            pre + "w3.weight": ("w_up", _t),
+            pre + "w2.weight": ("w_down", _t)}
 
 
 # ------------------------------------------------------------------- loading
@@ -780,11 +785,10 @@ def load_hf_checkpoint(path: str,
                 buf = None
                 for i in range(L):
                     for e in range(E):
-                        name, (_, tr) = next(
+                        name, (_, fn) = next(
                             (n, v) for n, v in _expert_names(i, e).items()
                             if v[0] == key)
-                        p = src.get(name)
-                        p = _t(p) if tr else p
+                        p = fn(src.get(name))
                         if buf is None:
                             buf = np.empty((L, E) + p.shape, p.dtype)
                         buf[i, e] = p
@@ -805,10 +809,8 @@ def load_hf_checkpoint(path: str,
             if cfg.any_moe:
                 stacked: Dict[str, list] = {}
                 for e in range(cfg.num_experts):
-                    for name, (key, tr) in _expert_names(i, e).items():
-                        arr = src.get(name)
-                        stacked.setdefault(key, []).append(
-                            _t(arr) if tr else arr)
+                    for name, (key, fn) in _expert_names(i, e).items():
+                        stacked.setdefault(key, []).append(fn(src.get(name)))
                 for key, mats in stacked.items():
                     lp.setdefault("moe", {})[key] = _put(
                         np.stack(mats), sharding_for("layers", str(i), "moe",

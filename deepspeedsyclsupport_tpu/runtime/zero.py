@@ -159,17 +159,32 @@ def tree_optimizer_shardings(opt_state, params, param_shardings, topo: MeshTopol
         shape = np.shape(leaf)
         if not shape:
             return replicated
-        if stage >= 3:
-            for i in range(len(kp)):
-                ent = path_to_sharding.get(jax.tree_util.keystr(kp[i:]))
-                if ent is not None and ent[0] == shape:
-                    return ent[1]
+        param_s = None
+        for i in range(len(kp)):
+            ent = path_to_sharding.get(jax.tree_util.keystr(kp[i:]))
+            if ent is not None and ent[0] == shape:
+                param_s = ent[1]
+                break
+        if stage >= 3 and param_s is not None:
+            return param_s
         if stage >= 1:
-            dim = choose_shard_dim(shape, n, threshold)
-            if dim is not None:
-                spec = [None] * len(shape)
-                spec[dim] = "fsdp"
-                return NamedSharding(mesh, PartitionSpec(*spec))
+            # ZeRO-1/2: partition over fsdp even though the param replicates
+            # there — but KEEP the param's TP/expert axes: a moment laid out
+            # differently from its gradient makes the SPMD partitioner
+            # full-rematerialize it every step (seen on MoE w_gate/w_up)
+            base = list(param_s.spec) if param_s is not None else []
+            base += [None] * (len(shape) - len(base))
+            # size gate on the FULL tensor (stage-3 precedent above): the
+            # masked free-shape product would under-count TP-sharded moments
+            # and silently skip their fsdp partitioning
+            if math.prod(shape) >= threshold:
+                free = tuple(d if s is None else 1
+                             for d, s in zip(shape, base))
+                dim = choose_shard_dim(free, n, threshold=0)
+                if dim is not None:
+                    base[dim] = "fsdp"
+            if any(s is not None for s in base):
+                return NamedSharding(mesh, PartitionSpec(*base))
         return replicated
 
     return jax.tree_util.tree_map_with_path(rule, opt_state)
