@@ -100,7 +100,7 @@ def config_from_hf(hf: Dict[str, Any], **overrides) -> ModelConfig:
             raise ValueError("post-layernorm OPT (do_layer_norm_before="
                              "False, 125m/350m) is not supported")
         wepd = hf.get("word_embed_proj_dim")
-        if wepd is not None and wepd != hf.get("hidden_size"):
+        if wepd is not None and wepd != hf.get("hidden_size", 768):
             raise ValueError(
                 f"OPT word_embed_proj_dim={wepd} != hidden_size — the "
                 f"project_in/project_out variant is not supported")

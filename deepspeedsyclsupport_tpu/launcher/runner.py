@@ -74,7 +74,7 @@ def build_world(args) -> List[Dict[str, str]]:
     n = len(hosts) * max(args.num_procs, 1)
     pid = 0
     for host, _slots in hosts:
-        for _ in range(max(args.num_procs, 1)):
+        for local in range(max(args.num_procs, 1)):
             world.append({
                 "host": host,
                 # names comm.init_distributed reads directly
@@ -87,7 +87,7 @@ def build_world(args) -> List[Dict[str, str]]:
                 "MASTER_PORT": coordinator.rsplit(":", 1)[1],
                 "RANK": str(pid),
                 "WORLD_SIZE": str(n),
-                "LOCAL_RANK": "0",
+                "LOCAL_RANK": str(local),
             })
             pid += 1
     return world
@@ -99,6 +99,33 @@ def _command(args, env: Dict[str, str]) -> List[str]:
         cmd.append("-m")
     cmd.append(args.user_script)
     cmd += args.user_args
+    if getattr(args, "bind_cores_to_rank", False):
+        # numa binding prefix (reference utils/numa.get_numactl_cmd +
+        # launcher --bind_cores_to_rank): carve this rank's core slice
+        from ..utils.numa import (check_for_numactl, get_numactl_cmd,
+                                  parse_range_list)
+
+        remote = env["host"] not in ("localhost", "127.0.0.1")
+        core_list = getattr(args, "bind_core_list", None)
+        if remote:
+            # the launcher cannot see a remote host's /sys topology — an
+            # explicit core list is the only sound basis, and membind is
+            # skipped (numa-node ids would be the launcher's, not theirs)
+            if not core_list:
+                raise ValueError(
+                    "--bind_cores_to_rank on remote hosts requires "
+                    "--bind_core_list (the launcher cannot read the remote "
+                    "NUMA topology)")
+            prefix, _ = get_numactl_cmd(
+                core_list, max(args.num_procs, 1), int(env["LOCAL_RANK"]),
+                numa_nodes=[parse_range_list(core_list)])
+        else:
+            if not getattr(args, "dry_run", False) and not check_for_numactl():
+                raise RuntimeError("--bind_cores_to_rank needs the numactl "
+                                   "binary on PATH")
+            prefix, _ = get_numactl_cmd(core_list, max(args.num_procs, 1),
+                                        int(env["LOCAL_RANK"]))
+        cmd = prefix + cmd
     if env["host"] not in ("localhost", "127.0.0.1"):
         exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items()
                            if k != "host")
@@ -120,6 +147,11 @@ def main(argv=None) -> int:
     p.add_argument("--master_addr", default=None)
     p.add_argument("--master_port", type=int, default=DEFAULT_MASTER_PORT)
     p.add_argument("--module", "-m", action="store_true")
+    p.add_argument("--bind_cores_to_rank", action="store_true",
+                   help="numactl-bind each local rank to its core slice "
+                        "(reference --bind_cores_to_rank)")
+    p.add_argument("--bind_core_list", default=None,
+                   help="core list to carve (e.g. '0-31,64-95'); default all")
     p.add_argument("--dry_run", action="store_true",
                    help="print the per-process commands and exit")
     p.add_argument("user_script")

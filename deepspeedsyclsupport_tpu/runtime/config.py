@@ -325,17 +325,29 @@ class CheckpointConfig:
     tag_validation: str = "Warn"  # Ignore | Warn | Fail
     use_node_local_storage: bool = False
     load_universal: bool = False
-    async_save: bool = True
+    # sync by default (reference: TorchCheckpointEngine); the async
+    # Nebula-analog engine is opt-in via async_save or engine="async"
+    async_save: bool = False
+    engine: str = "native"  # native | async (checkpoint/ckpt_engine.py)
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "CheckpointConfig":
         tv = str(d.get("tag_validation", "Warn")).capitalize()
         if tv not in ("Ignore", "Warn", "Fail"):
             raise ValueError(f"checkpoint.tag_validation must be Ignore|Warn|Fail, got {tv}")
+        async_save = bool(d.get("async_save", False))
+        engine = str(d.get("engine", "async" if async_save else "native"))
+        if engine not in ("native", "async"):
+            raise ValueError(f"checkpoint.engine must be native|async, got {engine!r}")
+        if "engine" in d and "async_save" in d and \
+                async_save != (engine == "async"):
+            raise ValueError(
+                f"contradictory checkpoint config: engine={engine!r} with "
+                f"async_save={async_save}")
         return cls(tag_validation=tv,
                    use_node_local_storage=bool(d.get("use_node_local_storage", False)),
                    load_universal=bool(d.get("load_universal", False)),
-                   async_save=bool(d.get("async_save", True)))
+                   async_save=async_save, engine=engine)
 
 
 @dataclass

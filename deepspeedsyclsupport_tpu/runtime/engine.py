@@ -133,6 +133,11 @@ class Engine:
         comms_logger.configure(enabled=self.config.comms_logger.enabled,
                                verbose=self.config.comms_logger.verbose)
 
+        from ..checkpoint.ckpt_engine import build_checkpoint_engine
+
+        self.checkpoint_engine = build_checkpoint_engine(
+            self.config.checkpoint.engine)
+
         # ---------------------------------------------------------- precision
         self.compute_dtype = self.config.compute_dtype
         fp16 = self.config.fp16
@@ -826,9 +831,9 @@ class Engine:
                         save_latest: bool = True) -> str:
         """Sharded checkpoint save (reference ``engine.save_checkpoint:3050``:
         mp-rank module files + per-DP-rank ZeRO shards + ``latest`` tag file —
-        here one orbax sharded tree serves all topologies)."""
-        from ..checkpoint.engine import save_tree
-
+        here one orbax sharded tree serves all topologies), through the
+        configured checkpoint engine (sync native, or the async Nebula-analog
+        that returns after the host snapshot)."""
         tag = tag or f"global_step{self.global_steps}"
         self._validate_tag(tag)
         path = os.path.join(save_dir, tag)
@@ -849,13 +854,15 @@ class Engine:
             meta["curriculum"] = self.curriculum_scheduler.state_dict()
         if self.random_ltd_scheduler is not None:
             meta["random_ltd"] = self.random_ltd_scheduler.state_dict()
-        save_tree(path, state, meta)
+        self.checkpoint_engine.save(
+            path, state, meta,
+            latest_file=(os.path.join(save_dir, LATEST_FILE)
+                         if save_latest else None),
+            tag=tag)
         if self._swapper is not None:
             self._swap_out_opt_state()
-        if save_latest and jax.process_index() == 0:
-            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                f.write(tag)
-        log_dist(f"saved checkpoint {path}")
+        log_dist(f"saved checkpoint {path} "
+                 f"({self.checkpoint_engine.name} engine)")
         return path
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
@@ -865,8 +872,9 @@ class Engine:
         orbax restores into the *current* shardings, so a checkpoint written on any
         topology loads on any other — the capability the reference needs universal
         checkpoints for."""
-        from ..checkpoint.engine import load_tree
-
+        load_tree = self.checkpoint_engine.load
+        # before resolving `latest`: an async save may still be writing it
+        self.checkpoint_engine.wait()
         if tag is None:
             latest = os.path.join(load_dir, LATEST_FILE)
             if not os.path.exists(latest):

@@ -1,0 +1,104 @@
+"""NUMA-aware process binding.
+
+Analog of the reference's ``deepspeed/utils/numa.py`` (202 LoC) +
+``--bind_cores_to_rank`` launcher flag: split the host's cores across local
+ranks and generate a ``numactl`` prefix per rank (``-C`` cpu list, ``-m``
+membind to the nodes those cores live on). On TPU hosts this is what keeps
+the input pipeline and host-side steps (aio swapper, cpu-offloaded optimizer)
+from bouncing across sockets.
+
+Topology comes from ``/sys/devices/system/node`` (no numactl dependency for
+discovery; ``numactl`` is only needed to RUN the generated prefix).
+"""
+import glob
+import os
+import re
+import shutil
+from typing import List, Optional, Tuple
+
+__all__ = ["parse_range_list", "get_numa_cores", "check_for_numactl",
+           "get_numactl_cmd"]
+
+
+def parse_range_list(spec: str) -> List[int]:
+    """``"0-3,8,10-11"`` → ``[0,1,2,3,8,10,11]`` (cpulist syntax)."""
+    out: List[int] = []
+    spec = spec.strip()
+    if not spec:
+        return out
+    for part in spec.split(","):
+        part = part.strip()
+        m = re.fullmatch(r"(\d+)-(\d+)", part)
+        if m:
+            lo, hi = int(m.group(1)), int(m.group(2))
+            if hi < lo:
+                raise ValueError(f"descending range {part!r}")
+            out.extend(range(lo, hi + 1))
+        elif re.fullmatch(r"\d+", part):
+            out.append(int(part))
+        else:
+            raise ValueError(f"bad core-list element {part!r}")
+    return sorted(set(out))
+
+
+def get_numa_cores(sys_node_dir: str = "/sys/devices/system/node"
+                   ) -> List[List[int]]:
+    """Cores per NUMA node. Falls back to one node with all cpus when the
+    sysfs topology is absent (containers, non-Linux)."""
+    nodes = []
+    for path in sorted(glob.glob(os.path.join(sys_node_dir, "node[0-9]*")),
+                       key=lambda p: int(re.search(r"(\d+)$", p).group(1))):
+        try:
+            with open(os.path.join(path, "cpulist")) as f:
+                nodes.append(parse_range_list(f.read()))
+        except OSError:
+            continue
+    if not nodes:
+        n = os.cpu_count() or 1
+        nodes = [list(range(n))]
+    return nodes
+
+
+def check_for_numactl() -> bool:
+    return shutil.which("numactl") is not None
+
+
+def _compact(cores: List[int]) -> str:
+    """[0,1,2,3,8] → "0-3,8" (inverse of :func:`parse_range_list`)."""
+    parts: List[str] = []
+    i = 0
+    while i < len(cores):
+        j = i
+        while j + 1 < len(cores) and cores[j + 1] == cores[j] + 1:
+            j += 1
+        parts.append(str(cores[i]) if i == j else f"{cores[i]}-{cores[j]}")
+        i = j + 1
+    return ",".join(parts)
+
+
+def get_numactl_cmd(bind_core_list: Optional[str], num_local_procs: int,
+                    local_rank: int,
+                    numa_nodes: Optional[List[List[int]]] = None
+                    ) -> Tuple[List[str], List[int]]:
+    """The reference's ``get_numactl_cmd``: carve this rank's core slice out
+    of ``bind_core_list`` (default: all cores) and return the ``numactl``
+    argv prefix plus the cores, membinding to the NUMA nodes that own them.
+    """
+    if num_local_procs < 1:
+        raise ValueError("num_local_procs must be >= 1")
+    numa_nodes = numa_nodes if numa_nodes is not None else get_numa_cores()
+    all_cores = (parse_range_list(bind_core_list) if bind_core_list
+                 else sorted(c for node in numa_nodes for c in node))
+    if len(all_cores) < num_local_procs:
+        raise ValueError(f"{len(all_cores)} cores cannot host "
+                         f"{num_local_procs} ranks")
+    per = len(all_cores) // num_local_procs
+    lo = local_rank * per
+    hi = len(all_cores) if local_rank == num_local_procs - 1 else lo + per
+    cores = all_cores[lo:hi]
+    mem_nodes = sorted({i for i, node in enumerate(numa_nodes)
+                        if set(node) & set(cores)})
+    cmd = ["numactl", "-C", _compact(cores)]
+    if mem_nodes and len(mem_nodes) < len(numa_nodes):
+        cmd += ["-m", ",".join(str(n) for n in mem_nodes)]
+    return cmd, cores

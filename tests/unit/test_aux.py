@@ -179,3 +179,74 @@ class TestAutotuner:
         bad = [t for t in res.trials
                if t["train_micro_batch_size_per_gpu"] == -1]
         assert bad and bad[0]["throughput"] == float("-inf")
+
+
+class TestNuma:
+    """NUMA binding (reference ``deepspeed/utils/numa.py`` +
+    ``--bind_cores_to_rank``)."""
+
+    def test_parse_and_compact_roundtrip(self):
+        from deepspeedsyclsupport_tpu.utils.numa import (_compact,
+                                                         parse_range_list)
+
+        assert parse_range_list("0-3,8,10-11") == [0, 1, 2, 3, 8, 10, 11]
+        assert _compact([0, 1, 2, 3, 8, 10, 11]) == "0-3,8,10-11"
+        with pytest.raises(ValueError):
+            parse_range_list("5-2")
+
+    def test_numactl_cmd_slices_cores(self):
+        from deepspeedsyclsupport_tpu.utils.numa import get_numactl_cmd
+
+        nodes = [[0, 1, 2, 3], [4, 5, 6, 7]]  # two numa nodes
+        cmd0, cores0 = get_numactl_cmd(None, 2, 0, numa_nodes=nodes)
+        cmd1, cores1 = get_numactl_cmd(None, 2, 1, numa_nodes=nodes)
+        assert cores0 == [0, 1, 2, 3] and cores1 == [4, 5, 6, 7]
+        assert cmd0 == ["numactl", "-C", "0-3", "-m", "0"]
+        assert cmd1 == ["numactl", "-C", "4-7", "-m", "1"]
+        # explicit core list, uneven split: last rank takes the remainder
+        cmd, cores = get_numactl_cmd("0-4", 2, 1, numa_nodes=nodes)
+        assert cores == [2, 3, 4]
+
+    def test_launcher_binds_cores(self, tmp_path):
+        from deepspeedsyclsupport_tpu.launcher.runner import (_command,
+                                                              build_world)
+
+        class A:
+            hostfile = None
+            num_nodes = 1
+            num_procs = 2
+            include = exclude = None
+            master_addr = None
+            master_port = 29500
+            module = False
+            user_script = "train.py"
+            user_args = []
+            bind_cores_to_rank = True
+            bind_core_list = "0-7"
+            dry_run = True  # skip the numactl-binary presence gate
+
+        world = build_world(A)
+        assert [e["LOCAL_RANK"] for e in world] == ["0", "1"]
+        c0 = _command(A, world[0])
+        c1 = _command(A, world[1])
+        assert c0[:3] == ["numactl", "-C", "0-3"]
+        assert c1[:3] == ["numactl", "-C", "4-7"]
+        assert c0[-1] == "train.py"
+        # remote host without an explicit core list must be rejected — the
+        # launcher cannot read a remote machine's NUMA topology
+        env = dict(world[0])
+        env["host"] = "worker-1"
+        A.bind_core_list = None
+        with pytest.raises(ValueError):
+            _command(A, env)
+        A.bind_core_list = "0-7"
+        rc = _command(A, env)
+        assert rc[0] == "ssh" and "numactl -C 0-3" in rc[-1]
+        assert "-m" not in rc[-1].split("train.py")[0].split("numactl")[1]
+
+    def test_numa_cores_fallback(self, tmp_path):
+        from deepspeedsyclsupport_tpu.utils.numa import get_numa_cores
+
+        # nonexistent sysfs dir → single synthetic node with all cpus
+        nodes = get_numa_cores(str(tmp_path / "nope"))
+        assert len(nodes) == 1 and len(nodes[0]) >= 1
