@@ -37,31 +37,20 @@ def model_flops_per_token(cfg):
     return 6 * n_active, attn
 
 
-def run_bench():
+def _measure(name, seq, micro_bs, steps, remat, platform):
+    """One bench rung: build → warmup/compile → timed steps → metrics dict.
+    Raises on OOM/compile failure; the caller's ladder steps down."""
     import jax
-
-    # The axon sitecustomize force-sets jax_platforms at interpreter start,
-    # so the JAX_PLATFORMS env var alone cannot steer the child; re-pin via
-    # jax.config before any backend initializes.
-    plat_override = os.environ.get("JAX_PLATFORMS")
-    if plat_override:
-        jax.config.update("jax_platforms", plat_override)
     import numpy as np
 
     import deepspeedsyclsupport_tpu as ds
+    from deepspeedsyclsupport_tpu.comm.topology import reset_world_topology
     from deepspeedsyclsupport_tpu.models import build_model, get_config
 
-    platform = jax.devices()[0].platform
-    on_tpu = platform == "tpu"
-    if on_tpu:
-        name, seq, micro_bs, steps = "llama2-1b", 1024, 4, 8
-        cfg = get_config(name, remat=True, max_seq_len=seq)
-    else:
-        name, seq, micro_bs, steps = "tiny", 256, 8, 4
-        cfg = get_config(name)
-
-    model = build_model(cfg)
+    cfg = get_config(name, remat=remat, max_seq_len=seq)
+    reset_world_topology()
     topo = ds.build_topology(dp=1)
+    model = build_model(cfg)
     config = {
         "train_batch_size": micro_bs,
         "train_micro_batch_size_per_gpu": micro_bs,
@@ -71,7 +60,8 @@ def run_bench():
     }
     engine, _, _, _ = ds.initialize(model=model, config=config, topology=topo)
     batch = {"input_ids": jax.random.randint(jax.random.PRNGKey(0),
-                                             (micro_bs, seq), 0, cfg.vocab_size)}
+                                             (micro_bs, seq), 0,
+                                             cfg.vocab_size)}
     # warmup/compile. NOTE: sync via value fetch (float), NOT block_until_ready —
     # on the axon remote-TPU platform block_until_ready returns before the
     # dispatch chain finishes; fetching the value is the reliable barrier.
@@ -91,15 +81,61 @@ def run_bench():
     flops_per_token = f_matmul + f_attn * seq
     achieved = tok_per_sec * flops_per_token
     mfu = achieved / PEAKS.get(platform, PEAKS["cpu"])
-    print(json.dumps({
+    return {
         "metric": f"train_tokens_per_sec_per_chip_{name}_seq{seq}",
         "value": round(tok_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / REFERENCE_MFU, 4),
         "detail": {"platform": platform, "mfu": round(mfu, 4),
                    "tflops": round(achieved / 1e12, 2),
+                   "micro_bs": micro_bs, "remat": remat,
                    "loss": round(float(np.asarray(m["loss"])), 4)},
-    }))
+    }
+
+
+def run_bench():
+    import jax
+
+    # The axon sitecustomize force-sets jax_platforms at interpreter start,
+    # so the JAX_PLATFORMS env var alone cannot steer the child; re-pin via
+    # jax.config before any backend initializes.
+    plat_override = os.environ.get("JAX_PLATFORMS")
+    if plat_override:
+        jax.config.update("jax_platforms", plat_override)
+
+    platform = jax.devices()[0].platform
+    if platform == "tpu":
+        # memory ladder for one 16GB v5e chip: fp32 master + Adam moments +
+        # fp32 grads peak at 16 bytes/param, so llama2-1b (~0.94B) is right
+        # at the edge — try it, then step down to the 650M config that fits
+        # with headroom (bigger micro-batch, and a no-remat rung that trades
+        # the recompute pass for activation memory)
+        ladder = [
+            ("llama2-1b", 1024, 4, 8, True),
+            ("llama2-1b", 1024, 2, 8, True),
+            ("llama-650m", 1024, 8, 8, False),
+            ("llama-650m", 1024, 8, 8, True),
+            ("llama-650m", 1024, 4, 8, True),
+        ]
+    else:
+        ladder = [("tiny", 256, 8, 4, False)]
+
+    import gc
+
+    last_err = None
+    for name, seq, micro, steps, remat in ladder:
+        try:
+            result = _measure(name, seq, micro, steps, remat, platform)
+            print(json.dumps(result))
+            return
+        except Exception as e:  # OOM / compile failure → next rung
+            last_err = f"{name} micro={micro} remat={remat}: {str(e)[:300]}"
+            print(f"bench rung failed: {last_err}", file=sys.stderr)
+        # drop the failed rung's buffers before the next attempt (the
+        # exception traceback pins the engine's frames until cleared)
+        gc.collect()
+        jax.clear_caches()
+    raise RuntimeError(f"all bench rungs failed; last: {last_err}")
 
 
 def _spawn(env_overrides, timeout=1500):
@@ -135,7 +171,13 @@ def main():
         ({"JAX_PLATFORMS": "cpu", "DSTPU_ACCELERATOR": "cpu"}, 900),
     ]
     errors = []
-    for overrides, timeout in attempts:
+    for i, (overrides, timeout) in enumerate(attempts):
+        if (i == 1 and errors and errors[-1]
+                and errors[-1].startswith("timeout")):
+            # a HUNG tunnel times out identically on retry — go straight to
+            # the guaranteed cpu rung instead of burning another window
+            errors.append("skipped retry after timeout")
+            continue
         line, err = _spawn(overrides, timeout)
         if line is not None:
             print(line)

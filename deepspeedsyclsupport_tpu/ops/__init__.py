@@ -7,3 +7,4 @@ are C++ behind a C ABI, JIT-built and loaded through :mod:`.op_builder` — the
 reference's ``OpBuilder.load()`` pattern without torch/pybind11.
 """
 from .op_builder import ALL_OPS, AsyncIOBuilder, OpBuilder, get_op_builder  # noqa: F401
+from .evoformer_attn import DS4Sci_EvoformerAttention  # noqa: F401

@@ -1,0 +1,70 @@
+"""EvoformerAttention — DS4Science fused MSA attention, TPU-native.
+
+API-compatible analog of the reference's ``DS4Sci_EvoformerAttention``
+(``deepspeed/ops/deepspeed4science/evoformer_attn.py``, backed by ~14.9k LoC
+of CUTLASS fMHA in ``csrc/deepspeed4science/evoformer_attn/``): attention
+over AlphaFold-style MSA tensors ``[B, N, S, H, D]`` with up to two additive
+logit biases —
+
+* ``bias1 [B, N, 1, 1, S]``: per-key residue-mask bias (0 / −inf rows;
+  non-differentiable, as in the reference kernels' mask role),
+* ``bias2 [B, 1, H, S, S]``: the pair-representation bias, shared across the
+  N MSA rows and differentiable (its gradient sums over N).
+
+Instead of a dedicated CUTLASS kernel family, the (B, N) leading dims
+flatten into the flash kernel's batch axis and the biases ride the kernel's
+broadcast-aware bias inputs (``ops/flash_attention.py``): ``bias2`` streams
+tile-by-tile with its batch index mapped ``b → b // N`` (never materialized
+per-row), and ``bias1`` collapses to the per-key row bias. Gradients flow
+through the kernel's fused backward (dbias2 reduced over the broadcast N).
+"""
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from .flash_attention import flash_attention
+
+__all__ = ["DS4Sci_EvoformerAttention", "evoformer_attention"]
+
+
+def evoformer_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        biases: Optional[List[Optional[jnp.ndarray]]] = None,
+                        block_q: int = 128, block_k: int = 128,
+                        interpret: Optional[bool] = None) -> jnp.ndarray:
+    """q/k/v: ``[B, N, S, H, D]``; ``biases``: up to
+    ``[mask_bias [B,N,1,1,Skv], pair_bias [B,1,H,Sq,Skv]]`` (either may be
+    None). Returns ``[B, N, Sq, H, D]``, non-causal.
+    """
+    if q.ndim != 5:
+        raise ValueError(f"expected [B, N, S, H, D], got {q.shape}")
+    b, n, sq, h, d = q.shape
+    skv = k.shape[2]
+    mask_bias = pair_bias = None
+    for bias in (biases or []):
+        if bias is None:
+            continue
+        if bias.ndim != 5:
+            raise ValueError(f"bias rank must be 5, got {bias.shape}")
+        if bias.shape[2] == 1 and bias.shape[3] == 1:
+            mask_bias = bias      # [B, N, 1, 1, Skv]
+        elif bias.shape[1] == 1:
+            pair_bias = bias      # [B, 1, H, Sq, Skv]
+        else:
+            raise ValueError(f"unrecognized evoformer bias shape "
+                             f"{bias.shape} (want [B,N,1,1,S] mask or "
+                             f"[B,1,H,S,S] pair)")
+
+    qf = q.reshape(b * n, sq, h, d)
+    kf = k.reshape(b * n, skv, h, d)
+    vf = v.reshape(b * n, skv, h, d)
+    k_bias = (mask_bias.reshape(b * n, skv)
+              if mask_bias is not None else None)
+    bias = pair_bias[:, 0] if pair_bias is not None else None  # [B,H,Sq,Skv]
+    out = flash_attention(qf, kf, vf, causal=False, bias=bias,
+                          k_bias=k_bias, block_q=block_q, block_k=block_k,
+                          interpret=interpret)
+    return out.reshape(b, n, sq, h, d)
+
+
+# reference-exact alias (deepspeed/ops/deepspeed4science/evoformer_attn.py)
+DS4Sci_EvoformerAttention = evoformer_attention

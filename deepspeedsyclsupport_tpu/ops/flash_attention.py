@@ -98,13 +98,37 @@ def _bias(s, ab_ref, pos_q, pos_k, use_alibi):
     return s + ab_ref[0, 0] * (pos_k - pos_q).astype(jnp.float32)
 
 
+def _split_bias_refs(refs, n_fixed, has_bias, has_kbias):
+    """Unpack the optional trailing bias input refs: ``refs[:n_fixed]`` are
+    the always-present inputs; then [pair-bias], then [k-row bias]."""
+    fixed = refs[:n_fixed]
+    rest = list(refs[n_fixed:])
+    b_ref = rest.pop(0) if has_bias else None
+    kb_ref = rest.pop(0) if has_kbias else None
+    assert not rest
+    return fixed, b_ref, kb_ref
+
+
+def _add_biases(s, b_ref, kb_ref):
+    """Additive attention biases (the EvoformerAttention pattern,
+    reference ``csrc/deepspeed4science/evoformer_attn/``): a [bq, bk]
+    pair-bias tile and/or a [1, bk] per-key row bias, both added AFTER the
+    1/√d scaling (the DS4Sci convention)."""
+    if b_ref is not None:
+        s = s + b_ref[0, 0].astype(jnp.float32)
+    if kb_ref is not None:
+        s = s + kb_ref[0].astype(jnp.float32)  # [1, bk] broadcasts over rows
+    return s
+
+
 # ------------------------------------------------------------------- forward
-def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, pq_ref, pk_ref,
-                ab_ref,                                # inputs
-                o_ref, lse_ref,                        # outputs
-                m_scr, l_scr, acc_scr,                 # scratch
-                *, scale, causal, skip_offset, q_len, kv_len,
-                block_q, block_k, num_kv_blocks, use_alibi, window):
+def _fwd_kernel(*refs, scale, causal, skip_offset, q_len, kv_len,
+                block_q, block_k, num_kv_blocks, use_alibi, window,
+                has_bias, has_kbias):
+    (inputs, b_ref, kb_ref) = _split_bias_refs(
+        refs[:-5], 8, has_bias, has_kbias)
+    q_ref, k_ref, v_ref, sq_ref, sk_ref, pq_ref, pk_ref, ab_ref = inputs
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = refs[-5:]
     i = pl.program_id(2)
     j = pl.program_id(3)
 
@@ -120,6 +144,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, pq_ref, pk_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         s = _bias(s, ab_ref, pq_ref[0], pk_ref[0], use_alibi)
+        s = _add_biases(s, b_ref, kb_ref)
         mask = _mask(i, j, sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0],
                      causal=causal, q_len=q_len, kv_len=kv_len,
                      block_q=block_q, block_k=block_k, window=window)
@@ -143,14 +168,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, pq_ref, pk_ref,
     if skip_offset is not None:
         # default-position causal: tiles strictly above the shifted diagonal
         # contribute nothing (custom positions rely on the dynamic skip)
-        @pl.when(jnp.logical_and(
-            (i + 1) * block_q - 1 + skip_offset >= j * block_k, live))
-        def _():
-            compute()
-    else:
-        @pl.when(live)
-        def _():
-            compute()
+        live = jnp.logical_and(
+            (i + 1) * block_q - 1 + skip_offset >= j * block_k, live)
+
+    @pl.when(live)
+    def _():
+        compute()
 
     @pl.when(j == num_kv_blocks - 1)
     def _():
@@ -160,11 +183,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, pq_ref, pk_ref,
 
 
 # ------------------------------------------------------------------ backward
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
-               pq_ref, pk_ref, ab_ref,
-               dq_ref, dq_scr,
-               *, scale, causal, skip_offset, q_len, kv_len,
-               block_q, block_k, num_kv_blocks, use_alibi, window):
+def _dq_kernel(*refs, scale, causal, skip_offset, q_len, kv_len,
+               block_q, block_k, num_kv_blocks, use_alibi, window,
+               has_bias, has_kbias, emit_dbias):
+    n_out = 3 if emit_dbias else 2  # dq_ref [, dbias_ref], dq_scr
+    (inputs, b_ref, kb_ref) = _split_bias_refs(
+        refs[:-n_out], 11, has_bias, has_kbias)
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
+     pq_ref, pk_ref, ab_ref) = inputs
+    if emit_dbias:
+        dq_ref, dbias_ref, dq_scr = refs[-3:]
+    else:
+        (dq_ref, dq_scr), dbias_ref = refs[-2:], None
     i = pl.program_id(2)
     j = pl.program_id(3)
 
@@ -180,6 +210,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         s = _bias(s, ab_ref, pq_ref[0], pk_ref[0], use_alibi)
+        s = _add_biases(s, b_ref, kb_ref)
         mask = _mask(i, j, sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0],
                      causal=causal, q_len=q_len, kv_len=kv_len,
                      block_q=block_q, block_k=block_k, window=window)
@@ -187,6 +218,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - dl_ref[0, 0])                            # [bq, bk]
+        if dbias_ref is not None:
+            # s = scaled-qk + bias ⇒ ∂L/∂bias tile is exactly ds
+            dbias_ref[0, 0] = ds.astype(dbias_ref.dtype)
         dq_scr[...] += scale * jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -194,25 +228,32 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
     live = _tile_live(sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0], causal,
                       window)
     if skip_offset is not None:
-        @pl.when(jnp.logical_and(
-            (i + 1) * block_q - 1 + skip_offset >= j * block_k, live))
+        live = jnp.logical_and(
+            (i + 1) * block_q - 1 + skip_offset >= j * block_k, live)
+
+    @pl.when(live)
+    def _():
+        compute()
+
+    if dbias_ref is not None:
+        # dead tiles still own their dbias output block — zero it
+        @pl.when(jnp.logical_not(live))
         def _():
-            compute()
-    else:
-        @pl.when(live)
-        def _():
-            compute()
+            dbias_ref[0, 0] = jnp.zeros_like(dbias_ref[0, 0])
 
     @pl.when(j == num_kv_blocks - 1)
     def _():
         dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
-                pq_ref, pk_ref, ab_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr,
-                *, scale, causal, skip_offset, q_len, kv_len,
-                block_q, block_k, num_q_blocks, use_alibi, window):
+def _dkv_kernel(*refs, scale, causal, skip_offset, q_len, kv_len,
+                block_q, block_k, num_q_blocks, use_alibi, window,
+                has_bias, has_kbias):
+    (inputs, b_ref, kb_ref) = _split_bias_refs(
+        refs[:-4], 11, has_bias, has_kbias)
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
+     pq_ref, pk_ref, ab_ref) = inputs
+    dk_ref, dv_ref, dk_scr, dv_scr = refs[-4:]
     j = pl.program_id(2)   # kv block (outer)
     i = pl.program_id(3)   # q block (inner, sequential accumulation)
 
@@ -229,6 +270,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         s = _bias(s, ab_ref, pq_ref[0], pk_ref[0], use_alibi)
+        s = _add_biases(s, b_ref, kb_ref)
         mask = _mask(i, j, sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0],
                      causal=causal, q_len=q_len, kv_len=kv_len,
                      block_q=block_q, block_k=block_k, window=window)
@@ -246,19 +288,133 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
     live = _tile_live(sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0], causal,
                       window)
     if skip_offset is not None:
-        @pl.when(jnp.logical_and(
-            (i + 1) * block_q - 1 + skip_offset >= j * block_k, live))
-        def _():
-            compute()
-    else:
-        @pl.when(live)
-        def _():
-            compute()
+        live = jnp.logical_and(
+            (i + 1) * block_q - 1 + skip_offset >= j * block_k, live)
+
+    @pl.when(live)
+    def _():
+        compute()
 
     @pl.when(i == num_q_blocks - 1)
     def _():
         dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _dbias_kernel(*refs, scale, causal, skip_offset, q_len, kv_len,
+                  block_q, block_k, num_replicas, use_alibi, window,
+                  has_kbias):
+    """Reduced-dbias backward for BROADCAST pair biases: grid
+    (bb, hb, i, j, r) with the replica axis r innermost-sequential, so the
+    [Bb, Hb, Sq, Skv] cotangent accumulates in VMEM scratch and the full
+    per-replica [B, H, Sq, Skv] tensor is never materialized in HBM (the
+    evoformer case: N MSA rows share one pair bias)."""
+    (inputs, b_ref, kb_ref) = _split_bias_refs(refs[:-2], 11, True,
+                                               has_kbias)
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
+     pq_ref, pk_ref, ab_ref) = inputs
+    dbias_ref, acc_scr = refs[-2:]
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    r = pl.program_id(4)
+
+    @pl.when(r == 0)
+    def _():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        s = _bias(s, ab_ref, pq_ref[0], pk_ref[0], use_alibi)
+        s = _add_biases(s, b_ref, kb_ref)
+        mask = _mask(i, j, sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0],
+                     causal=causal, q_len=q_len, kv_len=kv_len,
+                     block_q=block_q, block_k=block_k, window=window)
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0, 0]), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[...] += p * (dp - dl_ref[0, 0])
+
+    live = _tile_live(sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0], causal,
+                      window)
+    if skip_offset is not None:
+        live = jnp.logical_and(
+            (i + 1) * block_q - 1 + skip_offset >= j * block_k, live)
+
+    @pl.when(live)
+    def _():
+        compute()
+
+    @pl.when(r == num_replicas - 1)
+    def _():
+        dbias_ref[0, 0] = acc_scr[...].astype(dbias_ref.dtype)
+
+
+def _dbias_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab,
+                bias, kbias, *, scale, causal, skip_offset, q_len, kv_len,
+                block_q, block_k, use_alibi, window, interpret):
+    """Launch the reduced-dbias kernel; returns dbias of ``bias.shape``."""
+    b, h, sq, d = q.shape
+    kvh = k.shape[1]
+    skv = k.shape[2]
+    g = h // kvh
+    bb, hb = bias.shape[0], bias.shape[1]
+    rb, rh = b // bb, h // hb
+    nrep = rb * rh
+
+    def amap(fn):
+        # grid (bi, hi, i, j, r) → actual (b, h) = owner of replica r
+        def m(bi, hi, i, j, r):
+            return fn(bi * rb + r // rh, hi * rh + r % rh, i, j)
+        return m
+
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), amap(lambda b, h, i, j: (b, h, i, 0))),
+        pl.BlockSpec((1, 1, block_k, d),
+                     amap(lambda b, h, i, j: (b, h // g, j, 0))),
+        pl.BlockSpec((1, 1, block_k, d),
+                     amap(lambda b, h, i, j: (b, h // g, j, 0))),
+        pl.BlockSpec((1, 1, block_q, d), amap(lambda b, h, i, j: (b, h, i, 0))),
+        pl.BlockSpec((1, 1, block_q, 1), amap(lambda b, h, i, j: (b, h, i, 0))),
+        pl.BlockSpec((1, 1, block_q, 1), amap(lambda b, h, i, j: (b, h, i, 0))),
+        pl.BlockSpec((1, block_q, 1), amap(lambda b, h, i, j: (b, i, 0))),
+        pl.BlockSpec((1, 1, block_k), amap(lambda b, h, i, j: (b, 0, j))),
+        pl.BlockSpec((1, block_q, 1), amap(lambda b, h, i, j: (b, i, 0))),
+        pl.BlockSpec((1, 1, block_k), amap(lambda b, h, i, j: (b, 0, j))),
+        pl.BlockSpec((1, 1), lambda bi, hi, i, j, r: (hi * rh + r % rh, 0),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1, block_q, block_k),
+                     lambda bi, hi, i, j, r: (bi, hi, i, j)),
+    ]
+    arrays = [q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab, bias]
+    if kbias is not None:
+        kb = kbias.shape[0]
+        in_specs.append(pl.BlockSpec(
+            (1, block_k),
+            amap(lambda b, h, i, j: (b * kb // (bb * rb), j))))
+        arrays.append(kbias)
+    kern = functools.partial(
+        _dbias_kernel, scale=scale, causal=causal, skip_offset=skip_offset,
+        q_len=q_len, kv_len=kv_len, block_q=block_q, block_k=block_k,
+        num_replicas=nrep, use_alibi=use_alibi, window=window,
+        has_kbias=kbias is not None)
+    return pl.pallas_call(
+        kern,
+        grid=(bb, hb, sq // block_q, skv // block_k, nrep),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, block_q, block_k),
+                               lambda bi, hi, i, j, r: (bi, hi, i, j)),
+        out_shape=jax.ShapeDtypeStruct((bb, hb, sq, skv), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((block_q, block_k), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "parallel", "arbitrary")),
+        interpret=interpret,
+    )(*arrays)
 
 
 # ------------------------------------------------------------- pallas_call’s
@@ -267,9 +423,37 @@ def _alibi_spec():
                         memory_space=pltpu.SMEM)
 
 
-def _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, ab, *, scale, causal,
-              skip_offset, q_len, kv_len, block_q, block_k, use_alibi,
-              window, interpret):
+def _bias_specs(bias, kbias, b, h, block_q, block_k, swap_ij=False):
+    """Block specs + arrays for the optional additive biases. Pair bias
+    [Bb, Hb, Sq, Skv] broadcasts over batch groups / heads via its index
+    map; k-row bias [Bk, Skv] broadcasts over q rows inside the kernel."""
+    specs, arrays = [], []
+    if bias is not None:
+        bb, hb = bias.shape[0], bias.shape[1]
+
+        def bias_map(bi, hi, i, j):
+            if swap_ij:
+                i, j = j, i
+            return (bi * bb // b, hi * hb // h, i, j)
+
+        specs.append(pl.BlockSpec((1, 1, block_q, block_k), bias_map))
+        arrays.append(bias)
+    if kbias is not None:
+        kb = kbias.shape[0]
+
+        def kb_map(bi, hi, i, j):
+            if swap_ij:
+                i, j = j, i
+            return (bi * kb // b, j)
+
+        specs.append(pl.BlockSpec((1, block_k), kb_map))
+        arrays.append(kbias)
+    return specs, arrays
+
+
+def _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, ab, bias, kbias, *,
+              scale, causal, skip_offset, q_len, kv_len, block_q, block_k,
+              use_alibi, window, interpret):
     b, h, sq, d = q.shape
     kvh = k.shape[1]
     skv = k.shape[2]
@@ -279,7 +463,9 @@ def _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, ab, *, scale, causal,
         _fwd_kernel, scale=scale, causal=causal, skip_offset=skip_offset,
         q_len=q_len, kv_len=kv_len, block_q=block_q,
         block_k=block_k, num_kv_blocks=grid[3], use_alibi=use_alibi,
-        window=window)
+        window=window, has_bias=bias is not None,
+        has_kbias=kbias is not None)
+    b_specs, b_arrays = _bias_specs(bias, kbias, b, h, block_q, block_k)
     return pl.pallas_call(
         kern,
         grid=grid,
@@ -294,7 +480,7 @@ def _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, ab, *, scale, causal,
             pl.BlockSpec((1, block_q, 1), lambda b, h, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, block_k), lambda b, h, i, j: (b, 0, j)),
             _alibi_spec(),
-        ],
+        ] + b_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
@@ -312,10 +498,11 @@ def _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, ab, *, scale, causal,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v, seg_q, seg_k, pos_q, pos_k, ab)
+    )(q, k, v, seg_q, seg_k, pos_q, pos_k, ab, *b_arrays)
 
 
-def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab, *,
+def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab,
+              bias, kbias, *,
               scale, causal, skip_offset, q_len, kv_len, block_q, block_k,
               use_alibi, window, interpret):
     b, h, sq, d = q.shape
@@ -324,9 +511,17 @@ def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab, *,
     g = h // kvh
 
     nq, nkv = sq // block_q, skv // block_k
+    has_bias = bias is not None
+    # broadcast pair bias (evoformer: one bias shared by N MSA rows): the
+    # cotangent is produced by the dedicated reducing kernel so the full
+    # per-replica [B,H,Sq,Skv] tensor never hits HBM; full-shape biases
+    # emit dbias tiles straight from the dq kernel (no reduction needed)
+    bias_bcast = has_bias and (bias.shape[0] < b or bias.shape[1] < h)
+    emit_dbias = has_bias and not bias_bcast
     common = dict(scale=scale, causal=causal, skip_offset=skip_offset,
                   q_len=q_len, kv_len=kv_len, block_q=block_q,
-                  block_k=block_k, use_alibi=use_alibi, window=window)
+                  block_k=block_k, use_alibi=use_alibi, window=window,
+                  has_bias=has_bias, has_kbias=kbias is not None)
     q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0))
     kv_spec = pl.BlockSpec((1, 1, block_k, d),
                            lambda b, h, i, j: (b, h // g, j, 0))
@@ -334,20 +529,41 @@ def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab, *,
     sq_spec = pl.BlockSpec((1, block_q, 1), lambda b, h, i, j: (b, i, 0))
     sk_spec = pl.BlockSpec((1, 1, block_k), lambda b, h, i, j: (b, 0, j))
 
-    dq = pl.pallas_call(
-        functools.partial(_dq_kernel, num_kv_blocks=nkv, **common),
+    b_specs, b_arrays = _bias_specs(bias, kbias, b, h, block_q, block_k)
+    dq_out_specs = [pl.BlockSpec((1, 1, block_q, d),
+                                 lambda b, h, i, j: (b, h, i, 0))]
+    dq_out_shape = [jax.ShapeDtypeStruct((b, h, sq, d), jnp.float32)]
+    if emit_dbias:
+        dq_out_specs.append(pl.BlockSpec((1, 1, block_q, block_k),
+                                         lambda b, h, i, j: (b, h, i, j)))
+        dq_out_shape.append(
+            jax.ShapeDtypeStruct((b, h, sq, skv), jnp.float32))
+    dq_outs = pl.pallas_call(
+        functools.partial(_dq_kernel, num_kv_blocks=nkv,
+                          emit_dbias=emit_dbias, **common),
         grid=(b, h, nq, nkv),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec,
-                  sq_spec, sk_spec, sq_spec, sk_spec, _alibi_spec()],
-        out_specs=pl.BlockSpec((1, 1, block_q, d),
-                               lambda b, h, i, j: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), jnp.float32),
+                  sq_spec, sk_spec, sq_spec, sk_spec, _alibi_spec()]
+        + b_specs,
+        out_specs=dq_out_specs,
+        out_shape=dq_out_shape,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab)
+    )(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab, *b_arrays)
+    if emit_dbias:
+        dq, dbias = dq_outs
+    else:
+        (dq,), dbias = dq_outs, None
+    if bias_bcast:
+        dbias = _dbias_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q,
+                            pos_k, ab, bias, kbias, scale=scale,
+                            causal=causal, skip_offset=skip_offset,
+                            q_len=q_len, kv_len=kv_len, block_q=block_q,
+                            block_k=block_k, use_alibi=use_alibi,
+                            window=window, interpret=interpret)
 
     # grid reordered: kv block outer, q block inner (sequential accumulation)
     q_spec2 = pl.BlockSpec((1, 1, block_q, d), lambda b, h, j, i: (b, h, i, 0))
@@ -361,11 +577,14 @@ def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab, *,
                            lambda b, h, j, i: (b, h, j, 0))
     ab_spec2 = pl.BlockSpec((1, 1), lambda b, h, j, i: (h, 0),
                             memory_space=pltpu.SMEM)
+    b_specs2, b_arrays2 = _bias_specs(bias, kbias, b, h, block_q, block_k,
+                                      swap_ij=True)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, num_q_blocks=nq, **common),
         grid=(b, h, nkv, nq),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2,
-                  sq_spec2, sk_spec2, sq_spec2, sk_spec2, ab_spec2],
+                  sq_spec2, sk_spec2, sq_spec2, sk_spec2, ab_spec2]
+        + b_specs2,
         out_specs=[dkv_out, dkv_out],
         out_shape=[jax.ShapeDtypeStruct((b, h, skv, d), jnp.float32),
                    jax.ShapeDtypeStruct((b, h, skv, d), jnp.float32)],
@@ -375,41 +594,54 @@ def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab, *,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab)
+    )(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab, *b_arrays2)
     if g > 1:
         dk = dk.reshape(b, kvh, g, skv, d).sum(axis=2)
         dv = dv.reshape(b, kvh, g, skv, d).sum(axis=2)
-    return dq, dk, dv
+    return dq, dk, dv, dbias
 
 
 # ----------------------------------------------------------------- custom_vjp
 @functools.lru_cache(maxsize=None)
 def _make_flash(head_dim, causal, skip_offset, q_len, kv_len, block_q,
-                block_k, use_alibi, window, interpret):
+                block_k, use_alibi, window, has_bias, has_kbias, interpret):
     call_kw = dict(scale=1.0 / np.sqrt(head_dim), causal=causal,
                    skip_offset=skip_offset, q_len=q_len, kv_len=kv_len,
                    block_q=block_q, block_k=block_k, use_alibi=use_alibi,
                    window=window, interpret=interpret)
 
+    def split(bias, kbias):
+        return (bias if has_bias else None, kbias if has_kbias else None)
+
     @jax.custom_vjp
-    def f(q, k, v, seg_q, seg_k, pos_q, pos_k, ab):
-        o, _ = _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, ab, **call_kw)
+    def f(q, k, v, seg_q, seg_k, pos_q, pos_k, ab, bias, kbias):
+        o, _ = _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, ab,
+                         *split(bias, kbias), **call_kw)
         return o
 
-    def f_fwd(q, k, v, seg_q, seg_k, pos_q, pos_k, ab):
-        o, lse = _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, ab, **call_kw)
-        return o, (q, k, v, seg_q, seg_k, pos_q, pos_k, ab, o, lse)
+    def f_fwd(q, k, v, seg_q, seg_k, pos_q, pos_k, ab, bias, kbias):
+        o, lse = _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, ab,
+                           *split(bias, kbias), **call_kw)
+        return o, (q, k, v, seg_q, seg_k, pos_q, pos_k, ab, bias, kbias,
+                   o, lse)
 
     def f_bwd(res, do):
-        q, k, v, seg_q, seg_k, pos_q, pos_k, ab, o, lse = res
+        q, k, v, seg_q, seg_k, pos_q, pos_k, ab, bias, kbias, o, lse = res
         delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                         axis=-1, keepdims=True)            # [B,H,Sq,1]
-        dq, dk, dv = _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k,
-                               pos_q, pos_k, ab, **call_kw)
+        dq, dk, dv, dbias = _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k,
+                                      pos_q, pos_k, ab, *split(bias, kbias),
+                                      **call_kw)
         zero = lambda x: np.zeros(x.shape, jax.dtypes.float0)
+        # _bwd_call returns dbias already in the bias's (broadcast) shape —
+        # the reducing kernel handles replicated batch/head groups in VMEM
+        dbias = (dbias.astype(bias.dtype) if dbias is not None
+                 else jnp.zeros_like(bias))
+        # the k-row (mask) bias is non-differentiable by design — matching
+        # the role it plays in the evoformer API (a -inf validity mask)
         return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
                 zero(seg_q), zero(seg_k), zero(pos_q), zero(pos_k),
-                jnp.zeros_like(ab))
+                jnp.zeros_like(ab), dbias, jnp.zeros_like(kbias))
 
     f.defvjp(f_fwd, f_bwd)
     return f
@@ -424,6 +656,8 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     kv_positions: Optional[jnp.ndarray] = None,
                     alibi: Optional[jnp.ndarray] = None,
                     window: Optional[int] = None,
+                    bias: Optional[jnp.ndarray] = None,
+                    k_bias: Optional[jnp.ndarray] = None,
                     block_q: int = 512, block_k: int = 512,
                     interpret: Optional[bool] = None) -> jnp.ndarray:
     """Flash attention over ``q [B,Sq,H,D]``, ``k/v [B,Skv,KVH,D]``.
@@ -436,8 +670,12 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     positions instead of array indices. ``alibi``: per-head slopes [H]
     (BLOOM positional scheme, biasing logits by slope·(k_pos − q_pos));
     ``window``: sliding-window local attention (Mistral), with dead tiles
-    outside the window skipped on the MXU. Returns ``[B,Sq,H,D]`` in q's
-    dtype. Off-TPU runs in interpret mode.
+    outside the window skipped on the MXU. ``bias``: additive logit bias
+    ``[Bb, Hb, Sq, Skv]`` with ``Bb | B`` and ``Hb | H`` broadcast over
+    contiguous groups — differentiable (the EvoformerAttention pair bias);
+    ``k_bias``: per-key row bias ``[Bk, Skv]`` broadcast over q rows and
+    heads — NON-differentiable (the evoformer mask-bias role). Returns
+    ``[B,Sq,H,D]`` in q's dtype. Off-TPU runs in interpret mode.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -505,12 +743,30 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         ab = jnp.asarray(alibi, jnp.float32).reshape(h, 1)
     else:
         ab = jnp.zeros((h, 1), jnp.float32)
+    if bias is not None:
+        bb, hb = bias.shape[0], bias.shape[1]
+        if bias.shape[2:] != (sq, skv) or b % bb or h % hb:
+            raise ValueError(f"bias shape {bias.shape} incompatible with "
+                             f"q/kv ({b},{h},{sq},{skv})")
+        bias_p = jnp.pad(bias, ((0, 0), (0, 0), (0, sq_p - sq),
+                                (0, skv_p - skv)))
+    else:
+        bias_p = jnp.zeros((1, 1), jnp.float32)  # unused placeholder
+    if k_bias is not None:
+        if k_bias.shape[1] != skv or b % k_bias.shape[0]:
+            raise ValueError(f"k_bias shape {k_bias.shape} incompatible "
+                             f"with kv ({b},{skv})")
+        kbias_p = jnp.pad(k_bias, ((0, 0), (0, skv_p - skv)))
+    else:
+        kbias_p = jnp.zeros((1, 1), jnp.float32)  # unused placeholder
     fn = _make_flash(int(d), bool(causal),
                      None if skip_offset is None else int(skip_offset),
                      int(sq), int(skv), int(block_q), int(block_k),
                      alibi is not None,
                      None if window is None else int(window),
+                     bias is not None, k_bias is not None,
                      bool(interpret))
-    out = fn(qt, kt, vt, seg_q, seg_k, pos_q, pos_k, ab)  # [B,H,Sq_p,D_p]
+    out = fn(qt, kt, vt, seg_q, seg_k, pos_q, pos_k, ab, bias_p,
+             kbias_p)                                     # [B,H,Sq_p,D_p]
     out = out[:, :, :sq, :d]
     return jnp.transpose(out, (0, 2, 1, 3))

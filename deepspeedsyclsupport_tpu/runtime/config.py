@@ -344,6 +344,7 @@ class CheckpointConfig:
             raise ValueError(
                 f"contradictory checkpoint config: engine={engine!r} with "
                 f"async_save={async_save}")
+        async_save = engine == "async"  # keep the two views consistent
         return cls(tag_validation=tv,
                    use_node_local_storage=bool(d.get("use_node_local_storage", False)),
                    load_universal=bool(d.get("load_universal", False)),

@@ -250,3 +250,50 @@ class TestNuma:
         # nonexistent sysfs dir → single synthetic node with all cpus
         nodes = get_numa_cores(str(tmp_path / "nope"))
         assert len(nodes) == 1 and len(nodes[0]) >= 1
+
+
+class TestBenchLadder:
+    """bench.py resilience: the rung ladder must step down on failure and the
+    parent must not retry a timed-out (hung-tunnel) attempt."""
+
+    def test_ladder_steps_down(self, monkeypatch):
+        import bench
+
+        calls = []
+
+        def fake_measure(name, seq, micro, steps, remat, platform):
+            calls.append((name, micro, remat))
+            if len(calls) < 3:
+                raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+            return {"metric": "m", "value": 1.0, "unit": "tok/s",
+                    "vs_baseline": 0.5, "detail": {}}
+
+        class FakeDev:
+            platform = "tpu"
+
+        monkeypatch.setattr(bench, "_measure", fake_measure)
+        import jax
+
+        monkeypatch.setattr(jax, "devices", lambda *a: [FakeDev()])
+        monkeypatch.setattr(jax, "clear_caches", lambda: None)
+        bench.run_bench()
+        assert len(calls) == 3
+        assert calls[0][0] == "llama2-1b" and calls[2][0] == "llama-650m"
+
+    def test_parent_skips_retry_after_timeout(self, monkeypatch, capsys):
+        import bench
+
+        seen = []
+
+        def fake_spawn(overrides, timeout):
+            seen.append(dict(overrides))
+            if overrides.get("JAX_PLATFORMS") == "cpu":
+                return '{"metric": "m", "value": 1.0}', None
+            return None, "timeout: hung tunnel"
+
+        monkeypatch.setattr(bench, "_spawn", fake_spawn)
+        bench.main()
+        # native attempted ONCE (no retry after timeout), then cpu
+        assert len(seen) == 2
+        assert seen[1].get("JAX_PLATFORMS") == "cpu"
+        assert '"metric"' in capsys.readouterr().out
