@@ -98,15 +98,28 @@ def _bias(s, ab_ref, pos_q, pos_k, use_alibi):
     return s + ab_ref[0, 0] * (pos_k - pos_q).astype(jnp.float32)
 
 
-def _split_bias_refs(refs, n_fixed, has_bias, has_kbias):
-    """Unpack the optional trailing bias input refs: ``refs[:n_fixed]`` are
-    the always-present inputs; then [pair-bias], then [k-row bias]."""
+def _split_bias_refs(refs, n_fixed, has_bias, has_kbias, has_layout=False):
+    """Unpack the optional trailing input refs: ``refs[:n_fixed]`` are the
+    always-present inputs; then [pair-bias], [k-row bias], [block layout]."""
     fixed = refs[:n_fixed]
     rest = list(refs[n_fixed:])
     b_ref = rest.pop(0) if has_bias else None
     kb_ref = rest.pop(0) if has_kbias else None
+    l_ref = rest.pop(0) if has_layout else None
     assert not rest
-    return fixed, b_ref, kb_ref
+    return fixed, b_ref, kb_ref, l_ref
+
+
+def _layout_live(live, l_ref, i, j):
+    """AND a static block-sparsity layout (the reference's SparsityConfig
+    layouts, ``ops/sparse_attention/sparsity_config.py``) into the tile-skip:
+    layout [Hl, nq, nkv] sits whole in SMEM; dead blocks never touch the
+    MXU. Per-head layouts via Hl == H (head program id), Hl == 1 shares one
+    layout across heads."""
+    if l_ref is None:
+        return live
+    lh = pl.program_id(1) if l_ref.shape[0] > 1 else 0
+    return jnp.logical_and(live, l_ref[lh, i, j] != 0)
 
 
 def _add_biases(s, b_ref, kb_ref):
@@ -124,9 +137,9 @@ def _add_biases(s, b_ref, kb_ref):
 # ------------------------------------------------------------------- forward
 def _fwd_kernel(*refs, scale, causal, skip_offset, q_len, kv_len,
                 block_q, block_k, num_kv_blocks, use_alibi, window,
-                has_bias, has_kbias):
-    (inputs, b_ref, kb_ref) = _split_bias_refs(
-        refs[:-5], 8, has_bias, has_kbias)
+                has_bias, has_kbias, has_layout):
+    (inputs, b_ref, kb_ref, l_ref) = _split_bias_refs(
+        refs[:-5], 8, has_bias, has_kbias, has_layout)
     q_ref, k_ref, v_ref, sq_ref, sk_ref, pq_ref, pk_ref, ab_ref = inputs
     o_ref, lse_ref, m_scr, l_scr, acc_scr = refs[-5:]
     i = pl.program_id(2)
@@ -165,6 +178,7 @@ def _fwd_kernel(*refs, scale, causal, skip_offset, q_len, kv_len,
 
     live = _tile_live(sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0], causal,
                       window)
+    live = _layout_live(live, l_ref, i, j)
     if skip_offset is not None:
         # default-position causal: tiles strictly above the shifted diagonal
         # contribute nothing (custom positions rely on the dynamic skip)
@@ -185,10 +199,10 @@ def _fwd_kernel(*refs, scale, causal, skip_offset, q_len, kv_len,
 # ------------------------------------------------------------------ backward
 def _dq_kernel(*refs, scale, causal, skip_offset, q_len, kv_len,
                block_q, block_k, num_kv_blocks, use_alibi, window,
-               has_bias, has_kbias, emit_dbias):
+               has_bias, has_kbias, has_layout, emit_dbias):
     n_out = 3 if emit_dbias else 2  # dq_ref [, dbias_ref], dq_scr
-    (inputs, b_ref, kb_ref) = _split_bias_refs(
-        refs[:-n_out], 11, has_bias, has_kbias)
+    (inputs, b_ref, kb_ref, l_ref) = _split_bias_refs(
+        refs[:-n_out], 11, has_bias, has_kbias, has_layout)
     (q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
      pq_ref, pk_ref, ab_ref) = inputs
     if emit_dbias:
@@ -227,6 +241,7 @@ def _dq_kernel(*refs, scale, causal, skip_offset, q_len, kv_len,
 
     live = _tile_live(sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0], causal,
                       window)
+    live = _layout_live(live, l_ref, i, j)
     if skip_offset is not None:
         live = jnp.logical_and(
             (i + 1) * block_q - 1 + skip_offset >= j * block_k, live)
@@ -248,9 +263,9 @@ def _dq_kernel(*refs, scale, causal, skip_offset, q_len, kv_len,
 
 def _dkv_kernel(*refs, scale, causal, skip_offset, q_len, kv_len,
                 block_q, block_k, num_q_blocks, use_alibi, window,
-                has_bias, has_kbias):
-    (inputs, b_ref, kb_ref) = _split_bias_refs(
-        refs[:-4], 11, has_bias, has_kbias)
+                has_bias, has_kbias, has_layout):
+    (inputs, b_ref, kb_ref, l_ref) = _split_bias_refs(
+        refs[:-4], 11, has_bias, has_kbias, has_layout)
     (q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
      pq_ref, pk_ref, ab_ref) = inputs
     dk_ref, dv_ref, dk_scr, dv_scr = refs[-4:]
@@ -287,6 +302,7 @@ def _dkv_kernel(*refs, scale, causal, skip_offset, q_len, kv_len,
 
     live = _tile_live(sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0], causal,
                       window)
+    live = _layout_live(live, l_ref, i, j)
     if skip_offset is not None:
         live = jnp.logical_and(
             (i + 1) * block_q - 1 + skip_offset >= j * block_k, live)
@@ -309,8 +325,8 @@ def _dbias_kernel(*refs, scale, causal, skip_offset, q_len, kv_len,
     [Bb, Hb, Sq, Skv] cotangent accumulates in VMEM scratch and the full
     per-replica [B, H, Sq, Skv] tensor is never materialized in HBM (the
     evoformer case: N MSA rows share one pair bias)."""
-    (inputs, b_ref, kb_ref) = _split_bias_refs(refs[:-2], 11, True,
-                                               has_kbias)
+    (inputs, b_ref, kb_ref, _) = _split_bias_refs(refs[:-2], 11, True,
+                                                  has_kbias)
     (q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
      pq_ref, pk_ref, ab_ref) = inputs
     dbias_ref, acc_scr = refs[-2:]
@@ -451,7 +467,8 @@ def _bias_specs(bias, kbias, b, h, block_q, block_k, swap_ij=False):
     return specs, arrays
 
 
-def _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, ab, bias, kbias, *,
+def _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, ab, bias, kbias,
+              layout, *,
               scale, causal, skip_offset, q_len, kv_len, block_q, block_k,
               use_alibi, window, interpret):
     b, h, sq, d = q.shape
@@ -464,8 +481,11 @@ def _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, ab, bias, kbias, *,
         q_len=q_len, kv_len=kv_len, block_q=block_q,
         block_k=block_k, num_kv_blocks=grid[3], use_alibi=use_alibi,
         window=window, has_bias=bias is not None,
-        has_kbias=kbias is not None)
+        has_kbias=kbias is not None, has_layout=layout is not None)
     b_specs, b_arrays = _bias_specs(bias, kbias, b, h, block_q, block_k)
+    if layout is not None:
+        b_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        b_arrays.append(layout)
     return pl.pallas_call(
         kern,
         grid=grid,
@@ -502,7 +522,7 @@ def _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, ab, bias, kbias, *,
 
 
 def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab,
-              bias, kbias, *,
+              bias, kbias, layout, *,
               scale, causal, skip_offset, q_len, kv_len, block_q, block_k,
               use_alibi, window, interpret):
     b, h, sq, d = q.shape
@@ -521,7 +541,8 @@ def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab,
     common = dict(scale=scale, causal=causal, skip_offset=skip_offset,
                   q_len=q_len, kv_len=kv_len, block_q=block_q,
                   block_k=block_k, use_alibi=use_alibi, window=window,
-                  has_bias=has_bias, has_kbias=kbias is not None)
+                  has_bias=has_bias, has_kbias=kbias is not None,
+                  has_layout=layout is not None)
     q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0))
     kv_spec = pl.BlockSpec((1, 1, block_k, d),
                            lambda b, h, i, j: (b, h // g, j, 0))
@@ -530,6 +551,9 @@ def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab,
     sk_spec = pl.BlockSpec((1, 1, block_k), lambda b, h, i, j: (b, 0, j))
 
     b_specs, b_arrays = _bias_specs(bias, kbias, b, h, block_q, block_k)
+    if layout is not None:
+        b_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        b_arrays.append(layout)
     dq_out_specs = [pl.BlockSpec((1, 1, block_q, d),
                                  lambda b, h, i, j: (b, h, i, 0))]
     dq_out_shape = [jax.ShapeDtypeStruct((b, h, sq, d), jnp.float32)]
@@ -558,6 +582,10 @@ def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab,
     else:
         (dq,), dbias = dq_outs, None
     if bias_bcast:
+        if layout is not None:
+            raise NotImplementedError(
+                "block-sparse layouts with broadcast pair biases are not "
+                "supported together")
         dbias = _dbias_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q,
                             pos_k, ab, bias, kbias, scale=scale,
                             causal=causal, skip_offset=skip_offset,
@@ -579,6 +607,9 @@ def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab,
                             memory_space=pltpu.SMEM)
     b_specs2, b_arrays2 = _bias_specs(bias, kbias, b, h, block_q, block_k,
                                       swap_ij=True)
+    if layout is not None:
+        b_specs2.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        b_arrays2.append(layout)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, num_q_blocks=nq, **common),
         grid=(b, h, nkv, nq),
@@ -604,33 +635,37 @@ def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab,
 # ----------------------------------------------------------------- custom_vjp
 @functools.lru_cache(maxsize=None)
 def _make_flash(head_dim, causal, skip_offset, q_len, kv_len, block_q,
-                block_k, use_alibi, window, has_bias, has_kbias, interpret):
+                block_k, use_alibi, window, has_bias, has_kbias, has_layout,
+                interpret):
     call_kw = dict(scale=1.0 / np.sqrt(head_dim), causal=causal,
                    skip_offset=skip_offset, q_len=q_len, kv_len=kv_len,
                    block_q=block_q, block_k=block_k, use_alibi=use_alibi,
                    window=window, interpret=interpret)
 
-    def split(bias, kbias):
-        return (bias if has_bias else None, kbias if has_kbias else None)
+    def split(bias, kbias, layout):
+        return (bias if has_bias else None, kbias if has_kbias else None,
+                layout if has_layout else None)
 
     @jax.custom_vjp
-    def f(q, k, v, seg_q, seg_k, pos_q, pos_k, ab, bias, kbias):
+    def f(q, k, v, seg_q, seg_k, pos_q, pos_k, ab, bias, kbias, layout):
         o, _ = _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, ab,
-                         *split(bias, kbias), **call_kw)
+                         *split(bias, kbias, layout), **call_kw)
         return o
 
-    def f_fwd(q, k, v, seg_q, seg_k, pos_q, pos_k, ab, bias, kbias):
+    def f_fwd(q, k, v, seg_q, seg_k, pos_q, pos_k, ab, bias, kbias, layout):
         o, lse = _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, ab,
-                           *split(bias, kbias), **call_kw)
+                           *split(bias, kbias, layout), **call_kw)
         return o, (q, k, v, seg_q, seg_k, pos_q, pos_k, ab, bias, kbias,
-                   o, lse)
+                   layout, o, lse)
 
     def f_bwd(res, do):
-        q, k, v, seg_q, seg_k, pos_q, pos_k, ab, bias, kbias, o, lse = res
+        (q, k, v, seg_q, seg_k, pos_q, pos_k, ab, bias, kbias, layout, o,
+         lse) = res
         delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                         axis=-1, keepdims=True)            # [B,H,Sq,1]
         dq, dk, dv, dbias = _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k,
-                                      pos_q, pos_k, ab, *split(bias, kbias),
+                                      pos_q, pos_k, ab,
+                                      *split(bias, kbias, layout),
                                       **call_kw)
         zero = lambda x: np.zeros(x.shape, jax.dtypes.float0)
         # _bwd_call returns dbias already in the bias's (broadcast) shape —
@@ -641,7 +676,8 @@ def _make_flash(head_dim, causal, skip_offset, q_len, kv_len, block_q,
         # the role it plays in the evoformer API (a -inf validity mask)
         return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
                 zero(seg_q), zero(seg_k), zero(pos_q), zero(pos_k),
-                jnp.zeros_like(ab), dbias, jnp.zeros_like(kbias))
+                jnp.zeros_like(ab), dbias, jnp.zeros_like(kbias),
+                zero(layout))
 
     f.defvjp(f_fwd, f_bwd)
     return f
@@ -658,6 +694,7 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     window: Optional[int] = None,
                     bias: Optional[jnp.ndarray] = None,
                     k_bias: Optional[jnp.ndarray] = None,
+                    block_layout: Optional[jnp.ndarray] = None,
                     block_q: int = 512, block_k: int = 512,
                     interpret: Optional[bool] = None) -> jnp.ndarray:
     """Flash attention over ``q [B,Sq,H,D]``, ``k/v [B,Skv,KVH,D]``.
@@ -674,8 +711,12 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     ``[Bb, Hb, Sq, Skv]`` with ``Bb | B`` and ``Hb | H`` broadcast over
     contiguous groups — differentiable (the EvoformerAttention pair bias);
     ``k_bias``: per-key row bias ``[Bk, Skv]`` broadcast over q rows and
-    heads — NON-differentiable (the evoformer mask-bias role). Returns
-    ``[B,Sq,H,D]`` in q's dtype. Off-TPU runs in interpret mode.
+    heads — NON-differentiable (the evoformer mask-bias role).
+    ``block_layout``: static block-sparsity mask ``[Hl, ⌈Sq/block_q⌉,
+    ⌈Skv/block_k⌉]`` int (0 = dead block, skipped on the MXU), ``Hl`` ∈
+    {1, H} — the SparsityConfig layout contract (see
+    ``ops/sparse_attention.py``). Returns ``[B,Sq,H,D]`` in q's dtype.
+    Off-TPU runs in interpret mode.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -759,14 +800,32 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         kbias_p = jnp.pad(k_bias, ((0, 0), (0, skv_p - skv)))
     else:
         kbias_p = jnp.zeros((1, 1), jnp.float32)  # unused placeholder
+    if block_layout is not None:
+        nq_b, nkv_b = sq_p // block_q, skv_p // block_k
+        if (block_layout.ndim != 3 or block_layout.shape[0] not in (1, h)
+                or block_layout.shape[1:] != (nq_b, nkv_b)):
+            raise ValueError(
+                f"block_layout shape {block_layout.shape} must be "
+                f"[1|{h}, {nq_b}, {nkv_b}] for the padded block grid")
+        if bias is not None and (bias.shape[0] < b or bias.shape[1] < h):
+            # reject at the API boundary, not deep inside the backward: the
+            # reduced-dbias kernel does not consume block layouts
+            raise NotImplementedError(
+                "block_layout with a BROADCAST differentiable bias is not "
+                "supported (the reduced-dbias kernel ignores layouts); use "
+                "a full-shape bias or drop the layout")
+        layout_a = jnp.asarray(block_layout, jnp.int32)
+    else:
+        layout_a = jnp.zeros((1, 1, 1), jnp.int32)  # unused placeholder
     fn = _make_flash(int(d), bool(causal),
                      None if skip_offset is None else int(skip_offset),
                      int(sq), int(skv), int(block_q), int(block_k),
                      alibi is not None,
                      None if window is None else int(window),
                      bias is not None, k_bias is not None,
+                     block_layout is not None,
                      bool(interpret))
     out = fn(qt, kt, vt, seg_q, seg_k, pos_q, pos_k, ab, bias_p,
-             kbias_p)                                     # [B,H,Sq_p,D_p]
+             kbias_p, layout_a)                           # [B,H,Sq_p,D_p]
     out = out[:, :, :sq, :d]
     return jnp.transpose(out, (0, 2, 1, 3))

@@ -1,0 +1,227 @@
+"""Block-sparse attention — SparsityConfig layouts over the flash kernel.
+
+Analog of the reference's sparse-attention stack
+(``deepspeed/ops/sparse_attention/``: Triton block-sparse matmul/softmax +
+``sparsity_config.py`` layout family + ``SparseSelfAttention``). TPU-native
+shape: the layouts are the SAME contract — a ``[Hl, nb, nb]`` 0/1 block mask
+— but instead of dedicated block-sparse matmul kernels, the mask rides the
+flash kernel's static tile-skip (``ops/flash_attention.py block_layout``):
+dead blocks are skipped on the MXU while the streaming softmax handles the
+live ones, so sparsity translates directly into compute savings.
+
+Config surface mirrors the reference classes (``sparsity_config.py:15-700``):
+Dense, LocalSlidingWindow, Fixed, BigBird, BSLongformer.
+"""
+from typing import List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = ["SparsityConfig", "DenseSparsityConfig",
+           "LocalSlidingWindowSparsityConfig", "FixedSparsityConfig",
+           "BigBirdSparsityConfig", "BSLongformerSparsityConfig",
+           "sparse_attention"]
+
+
+class SparsityConfig:
+    """Base: ``make_layout(seq_len)`` → int32 ``[Hl, nb, nb]`` block mask
+    (reference ``SparsityConfig.setup_layout``)."""
+
+    def __init__(self, num_heads: int, block: int = 128,
+                 different_layout_per_head: bool = False):
+        self.num_heads = num_heads
+        self.block = block
+        self.different_layout_per_head = different_layout_per_head
+
+    @property
+    def layout_heads(self) -> int:
+        return self.num_heads if self.different_layout_per_head else 1
+
+    def _empty(self, seq_len: int) -> np.ndarray:
+        if seq_len % self.block:
+            raise ValueError(f"seq_len {seq_len} not a multiple of "
+                             f"block {self.block}")
+        nb = seq_len // self.block
+        return np.zeros((self.layout_heads, nb, nb), np.int32)
+
+    def _finish(self, layout: np.ndarray, causal: bool) -> np.ndarray:
+        if causal:
+            layout = layout * np.tril(
+                np.ones(layout.shape[1:], np.int32))[None]
+        return layout
+
+    def make_layout(self, seq_len: int, causal: bool = True) -> np.ndarray:
+        raise NotImplementedError
+
+
+class DenseSparsityConfig(SparsityConfig):
+    """All blocks live (reference ``DenseSparsityConfig`` — the debugging /
+    parity baseline)."""
+
+    def make_layout(self, seq_len: int, causal: bool = True) -> np.ndarray:
+        layout = self._empty(seq_len)
+        layout[:] = 1
+        return self._finish(layout, causal)
+
+
+class LocalSlidingWindowSparsityConfig(SparsityConfig):
+    """Banded local attention (reference
+    ``LocalSlidingWindowSparsityConfig``)."""
+
+    def __init__(self, num_heads: int, block: int = 128,
+                 num_sliding_window_blocks: int = 3,
+                 different_layout_per_head: bool = False):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+
+    def make_layout(self, seq_len: int, causal: bool = True) -> np.ndarray:
+        layout = self._empty(seq_len)
+        nb = layout.shape[1]
+        w = self.num_sliding_window_blocks
+        for i in range(nb):
+            lo = max(0, i - w // 2) if not causal else max(0, i - w + 1)
+            hi = min(nb, i + w // 2 + 1) if not causal else i + 1
+            layout[:, i, lo:hi] = 1
+        return self._finish(layout, causal)
+
+
+class FixedSparsityConfig(SparsityConfig):
+    """Local windows + periodic global columns (reference
+    ``FixedSparsityConfig``, the Sparse-Transformer 'fixed' pattern): rows
+    attend their own local window of ``num_local_blocks``, plus the last
+    ``num_global_blocks`` block-columns of every window (the 'summary'
+    columns). ``num_different_global_patterns`` rotates which columns act as
+    global across head groups (requires per-head layouts)."""
+
+    def __init__(self, num_heads: int, block: int = 128,
+                 different_layout_per_head: bool = False,
+                 num_local_blocks: int = 4, num_global_blocks: int = 1,
+                 horizontal_global_attention: bool = False,
+                 num_different_global_patterns: int = 1):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_local_blocks = num_local_blocks
+        self.num_global_blocks = num_global_blocks
+        self.horizontal_global_attention = horizontal_global_attention
+        if num_different_global_patterns > 1 and not different_layout_per_head:
+            raise ValueError("num_different_global_patterns > 1 requires "
+                             "different_layout_per_head")
+        if num_different_global_patterns > num_local_blocks // max(
+                num_global_blocks, 1):
+            raise ValueError("more global patterns than fit in a window")
+        self.num_different_global_patterns = num_different_global_patterns
+
+    def make_layout(self, seq_len: int, causal: bool = True) -> np.ndarray:
+        layout = self._empty(seq_len)
+        nb = layout.shape[1]
+        nl, ng = self.num_local_blocks, self.num_global_blocks
+        for h in range(layout.shape[0]):
+            pat = (h * self.num_different_global_patterns //
+                   max(layout.shape[0], 1)) if \
+                self.num_different_global_patterns > 1 else 0
+            for i in range(nb):
+                w0 = (i // nl) * nl
+                layout[h, i, w0:min(w0 + nl, nb)] = 1  # local window
+            for w0 in range(0, nb, nl):
+                # global columns: the pattern-selected ng columns at this
+                # window's tail (pattern p shifts them back by p·ng)
+                c_hi = min(w0 + nl, nb) - pat * ng
+                c_lo = max(c_hi - ng, 0)
+                layout[h, :, c_lo:c_hi] = 1
+                if self.horizontal_global_attention:
+                    layout[h, c_lo:c_hi, :] = 1
+        return self._finish(layout, causal)
+
+
+class BigBirdSparsityConfig(SparsityConfig):
+    """Sliding window + global first/last blocks + random blocks (reference
+    ``BigBirdSparsityConfig``)."""
+
+    def __init__(self, num_heads: int, block: int = 128,
+                 different_layout_per_head: bool = False,
+                 num_random_blocks: int = 1,
+                 num_sliding_window_blocks: int = 3,
+                 num_global_blocks: int = 1, seed: int = 0):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_random_blocks = num_random_blocks
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.num_global_blocks = num_global_blocks
+        self.seed = seed
+
+    def make_layout(self, seq_len: int, causal: bool = True) -> np.ndarray:
+        layout = self._empty(seq_len)
+        nb = layout.shape[1]
+        w = self.num_sliding_window_blocks
+        g = min(self.num_global_blocks, nb)
+        rng = np.random.RandomState(self.seed)
+        for h in range(layout.shape[0]):
+            for i in range(nb):
+                lo, hi = max(0, i - w // 2), min(nb, i + w // 2 + 1)
+                layout[h, i, lo:hi] = 1                   # sliding window
+                cand = np.arange(0, i + 1 if causal else nb)
+                if len(cand):
+                    pick = rng.choice(cand, size=min(self.num_random_blocks,
+                                                     len(cand)),
+                                      replace=False)
+                    layout[h, i, pick] = 1                # random blocks
+            layout[h, :, :g] = 1                          # global columns
+            layout[h, :g, :] = 1                          # global rows
+            if not causal:
+                layout[h, :, nb - g:] = 1
+                layout[h, nb - g:, :] = 1
+        return self._finish(layout, causal)
+
+
+class BSLongformerSparsityConfig(SparsityConfig):
+    """Sliding window + designated global block indices (reference
+    ``BSLongformerSparsityConfig``)."""
+
+    def __init__(self, num_heads: int, block: int = 128,
+                 different_layout_per_head: bool = False,
+                 num_sliding_window_blocks: int = 3,
+                 global_block_indices: Optional[List[int]] = None,
+                 global_block_end_indices: Optional[List[int]] = None):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.global_block_indices = global_block_indices or [0]
+        self.global_block_end_indices = global_block_end_indices
+
+    def make_layout(self, seq_len: int, causal: bool = True) -> np.ndarray:
+        layout = self._empty(seq_len)
+        nb = layout.shape[1]
+        w = self.num_sliding_window_blocks
+        for i in range(nb):
+            lo, hi = max(0, i - w // 2), min(nb, i + w // 2 + 1)
+            layout[:, i, lo:hi] = 1
+        ends = self.global_block_end_indices
+        for n, start in enumerate(self.global_block_indices):
+            stop = ends[n] if ends else start + 1
+            layout[:, :, start:stop] = 1    # everyone sees global blocks
+            layout[:, start:stop, :] = 1    # global blocks see everyone
+        return self._finish(layout, causal)
+
+
+def sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                     config: SparsityConfig, causal: bool = True,
+                     interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Block-sparse attention over ``q/k/v [B, S, H, D]`` (the
+    ``SparseSelfAttention.forward`` analog): builds the config's layout for
+    the padded block grid and runs the flash kernel with dead blocks
+    skipped."""
+    from .flash_attention import _round_up, flash_attention
+
+    b, s, h, d = q.shape
+    if h != config.num_heads:
+        raise ValueError(f"config.num_heads={config.num_heads} != {h}")
+    blk = config.block
+    if blk > _round_up(s, 128):
+        # the kernel clamps its blocks to the 128-padded sequence; a layout
+        # block coarser than that cannot map onto the launch grid
+        raise ValueError(f"config.block={blk} exceeds the padded sequence "
+                         f"({_round_up(s, 128)}) — use a smaller block")
+    s_pad = _round_up(s, blk)
+    layout = config.make_layout(s_pad, causal=causal)
+
+    return flash_attention(q, k, v, causal=causal,
+                           block_layout=jnp.asarray(layout),
+                           block_q=blk, block_k=blk, interpret=interpret)

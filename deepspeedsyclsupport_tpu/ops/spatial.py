@@ -1,0 +1,40 @@
+"""Spatial (diffusers) ops — fused bias-add family.
+
+Analog of the reference's ``csrc/spatial/csrc/opt_bias_add.cu`` (298 LoC
+CUDA) behind ``op_builder/spatial_inference.py``, used by its diffusers
+UNet/VAE integration (``deepspeed/ops/transformer/inference/diffusers_*``).
+On TPU these are pure jnp compositions — XLA fuses the bias/residual adds
+into the producing matmul/conv epilogue, which is the entire point of the
+CUDA kernels — so the value here is the stable op surface, kept so
+diffusers-style model code ports 1:1.
+"""
+from typing import Optional
+
+import jax.numpy as jnp
+
+__all__ = ["bias_add", "bias_add_add", "nhwc_bias_add"]
+
+
+def bias_add(activation: jnp.ndarray, bias: jnp.ndarray) -> jnp.ndarray:
+    """``activation [..., C] + bias [C]`` (reference ``opt_bias_add``)."""
+    return activation + bias.astype(activation.dtype)
+
+
+def bias_add_add(activation: jnp.ndarray, bias: jnp.ndarray,
+                 other: jnp.ndarray) -> jnp.ndarray:
+    """Fused bias + residual add (reference ``opt_bias_add_add``)."""
+    return activation + bias.astype(activation.dtype) + other
+
+
+def nhwc_bias_add(activation: jnp.ndarray, bias: jnp.ndarray,
+                  other: Optional[jnp.ndarray] = None,
+                  other_bias: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """The reference's general entry (``nhwc_bias_add`` binding): NHWC
+    activation + per-channel bias, optionally adding a second activation
+    (+ its own bias) — the UNet residual-merge pattern."""
+    out = activation + bias.astype(activation.dtype)
+    if other is not None:
+        out = out + other
+        if other_bias is not None:
+            out = out + other_bias.astype(activation.dtype)
+    return out

@@ -297,3 +297,58 @@ class TestBenchLadder:
         assert len(seen) == 2
         assert seen[1].get("JAX_PLATFORMS") == "cpu"
         assert '"metric"' in capsys.readouterr().out
+
+
+class TestSpatialAndTiling:
+    """ops/spatial (diffusers fused bias-add family, reference
+    csrc/spatial/) and runtime/tiling (reference runtime/zero/tiling.py)."""
+
+    def test_spatial_bias_adds(self):
+        from deepspeedsyclsupport_tpu.ops.spatial import (bias_add,
+                                                          bias_add_add,
+                                                          nhwc_bias_add)
+
+        x = jnp.ones((2, 4, 4, 8))
+        b = jnp.arange(8.0)
+        np.testing.assert_allclose(np.asarray(bias_add(x, b)),
+                                   np.asarray(x + b))
+        other = jnp.full_like(x, 2.0)
+        np.testing.assert_allclose(np.asarray(bias_add_add(x, b, other)),
+                                   np.asarray(x + b + other))
+        ob = jnp.ones((8,))
+        np.testing.assert_allclose(
+            np.asarray(nhwc_bias_add(x, b, other, ob)),
+            np.asarray(x + b + other + ob))
+
+    @pytest.mark.parametrize("in_splits,out_splits",
+                             [(1, 1), (4, 1), (1, 4), (2, 2)])
+    def test_tiled_linear_matches_dense(self, in_splits, out_splits):
+        from deepspeedsyclsupport_tpu.runtime.tiling import tiled_linear
+
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+        x = jax.random.normal(k1, (3, 5, 32))
+        w = jax.random.normal(k2, (32, 16))
+        b = jax.random.normal(k3, (16,))
+        want = x @ w + b
+        got = tiled_linear(x, w, b, in_splits=in_splits,
+                           out_splits=out_splits)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_tiled_linear_grad(self):
+        from deepspeedsyclsupport_tpu.runtime.tiling import tiled_linear
+
+        k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+        x = jax.random.normal(k1, (4, 32))
+        w = jax.random.normal(k2, (32, 16))
+        g1 = jax.grad(lambda w: (tiled_linear(x, w, in_splits=4,
+                                              out_splits=2) ** 2).sum())(w)
+        g2 = jax.grad(lambda w: ((x @ w) ** 2).sum())(w)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_tiled_linear_bad_splits(self):
+        from deepspeedsyclsupport_tpu.runtime.tiling import tiled_linear
+
+        with pytest.raises(ValueError):
+            tiled_linear(jnp.ones((2, 32)), jnp.ones((32, 16)), in_splits=5)
