@@ -176,6 +176,7 @@ class CausalLM:
                  rng: Optional[jax.Array] = None,
                  kv_mask: Optional[jnp.ndarray] = None,
                  kv_positions: Optional[jnp.ndarray] = None,
+                 pld_theta: Optional[jnp.ndarray] = None,
                  train: bool = True
                  ) -> Tuple[jnp.ndarray, Optional[KVCache], jnp.ndarray]:
         """Returns (logits [B,S,V] fp32, new_cache, total_aux_loss)."""
@@ -267,16 +268,35 @@ class CausalLM:
         elif cfg.scan_layers:
             dummy = jnp.zeros((cfg.num_layers, 0)) if cache is None else None
             ks = jax.random.split(rng, cfg.num_layers)
+            # Progressive Layer Dropping (reference
+            # runtime/progressive_layer_drop.py, arXiv:2010.13369): per-layer
+            # keep prob p_l = 1 − (l+1)/L·(1−θ(t)); dropped layers skip via
+            # lax.cond so they cost neither FLOPs nor activation memory
+            use_pld = (pld_theta is not None and train and cache is None)
 
             def body(x, inp):
-                p, ck, cv, rng_l = inp
-                x, nck, ncv, aux = layer_fn(x, p, ck, cv, rng_l)
+                p, ck, cv, rng_l, li = inp
+                if not use_pld:
+                    x, nck, ncv, aux = layer_fn(x, p, ck, cv, rng_l)
+                    return x, ((nck, ncv), aux)
+                keep_p = 1.0 - (li + 1).astype(jnp.float32) / cfg.num_layers \
+                    * (1.0 - pld_theta)
+                keep = jax.random.bernoulli(jax.random.fold_in(rng_l, 17),
+                                            keep_p)
+
+                def run(_):
+                    return layer_fn(x, p, ck, cv, rng_l)
+
+                def skip(_):
+                    return x, ck, cv, jnp.zeros((), jnp.float32)
+
+                x, nck, ncv, aux = jax.lax.cond(keep, run, skip, None)
                 return x, ((nck, ncv), aux)
 
             xs = (params["layers"],
                   cache.k if cache is not None else dummy,
                   cache.v if cache is not None else dummy,
-                  ks)
+                  ks, jnp.arange(cfg.num_layers))
             x, ((nk, nv), auxes) = jax.lax.scan(body, x, xs)
             aux_total = auxes.sum()
             if cache is not None:
@@ -284,11 +304,23 @@ class CausalLM:
         else:
             aux_total = jnp.zeros((), jnp.float32)
             nks, nvs = [], []
+            use_pld = (pld_theta is not None and train and cache is None)
             for i, p in enumerate(params["layers"]):
                 ck = cache.k[i] if cache is not None else None
                 cv = cache.v[i] if cache is not None else None
-                x, nck, ncv, aux = layer_fn(x, p, ck, cv,
-                                            jax.random.fold_in(rng, i))
+                rng_l = jax.random.fold_in(rng, i)
+                if use_pld:
+                    keep_p = 1.0 - (i + 1) / cfg.num_layers \
+                        * (1.0 - pld_theta)
+                    keep = jax.random.bernoulli(
+                        jax.random.fold_in(rng_l, 17), keep_p)
+                    x, nck, ncv, aux = jax.lax.cond(
+                        keep,
+                        lambda _: layer_fn(x, p, ck, cv, rng_l),
+                        lambda _: (x, ck, cv, jnp.zeros((), jnp.float32)),
+                        None)
+                else:
+                    x, nck, ncv, aux = layer_fn(x, p, ck, cv, rng_l)
                 aux_total = aux_total + aux
                 if cache is not None:
                     nks.append(nck)
@@ -321,7 +353,8 @@ class CausalLM:
         logits, _, aux = self._forward(
             params, input_ids,
             positions=batch.get("positions"),
-            segment_ids=batch.get("segment_ids"), rng=rng, train=train)
+            segment_ids=batch.get("segment_ids"), rng=rng,
+            pld_theta=batch.get("pld_theta"), train=train)
         if "labels" in batch:
             labels = batch["labels"]
             mask = batch.get("loss_mask", (labels >= 0).astype(jnp.float32))

@@ -184,6 +184,12 @@ class BSLongformerSparsityConfig(SparsityConfig):
         super().__init__(num_heads, block, different_layout_per_head)
         self.num_sliding_window_blocks = num_sliding_window_blocks
         self.global_block_indices = global_block_indices or [0]
+        if global_block_end_indices is not None and \
+                len(global_block_end_indices) != len(self.global_block_indices):
+            raise ValueError(
+                f"global_block_end_indices ({len(global_block_end_indices)}) "
+                f"must match global_block_indices "
+                f"({len(self.global_block_indices)})")
         self.global_block_end_indices = global_block_end_indices
 
     def make_layout(self, seq_len: int, causal: bool = True) -> np.ndarray:

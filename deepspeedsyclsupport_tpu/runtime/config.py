@@ -352,6 +352,21 @@ class CheckpointConfig:
 
 
 @dataclass
+class ProgressiveLayerDropConfig:
+    """``progressive_layer_drop`` section (reference:
+    ``runtime/progressive_layer_drop.py``, constants PLD_*)."""
+    enabled: bool = False
+    theta: float = 0.5
+    gamma: float = 0.001
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ProgressiveLayerDropConfig":
+        return cls(enabled=bool(d.get("enabled", False)),
+                   theta=float(d.get("theta", 0.5)),
+                   gamma=float(d.get("gamma", 0.001)))
+
+
+@dataclass
 class DataEfficiencyConfig:
     """``data_efficiency`` section (reference:
     ``runtime/data_pipeline/config.py`` + ``constants.py`` key families),
@@ -415,6 +430,8 @@ class DSTpuConfig:
     comms_logger: CommsLoggerConfig
     flops_profiler: FlopsProfilerConfig
     checkpoint: CheckpointConfig
+    progressive_layer_drop: ProgressiveLayerDropConfig = field(
+        default_factory=ProgressiveLayerDropConfig)
     data_efficiency: DataEfficiencyConfig = field(
         default_factory=DataEfficiencyConfig)
     gradient_clipping: float = C.GRADIENT_CLIPPING_DEFAULT
@@ -465,6 +482,8 @@ class DSTpuConfig:
             comms_logger=CommsLoggerConfig.from_dict(_sub(d, C.COMMS_LOGGER)),
             flops_profiler=FlopsProfilerConfig.from_dict(_sub(d, C.FLOPS_PROFILER)),
             checkpoint=CheckpointConfig.from_dict(_sub(d, C.CHECKPOINT)),
+            progressive_layer_drop=ProgressiveLayerDropConfig.from_dict(
+                _sub(d, "progressive_layer_drop")),
             data_efficiency=DataEfficiencyConfig.from_config_dict(d),
             gradient_clipping=float(d.get(C.GRADIENT_CLIPPING,
                                           C.GRADIENT_CLIPPING_DEFAULT)),

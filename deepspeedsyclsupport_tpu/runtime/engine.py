@@ -138,6 +138,18 @@ class Engine:
         self.checkpoint_engine = build_checkpoint_engine(
             self.config.checkpoint.engine)
 
+        self.progressive_layer_drop = None
+        if self.config.progressive_layer_drop.enabled:
+            from .progressive_layer_drop import ProgressiveLayerDrop
+
+            if self.config.data_efficiency.random_ltd is not None:
+                raise ValueError(
+                    "progressive_layer_drop and random_ltd cannot be "
+                    "combined (both restructure the layer stack)")
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=self.config.progressive_layer_drop.theta,
+                gamma=self.config.progressive_layer_drop.gamma)
+
         # ---------------------------------------------------------- precision
         self.compute_dtype = self.config.compute_dtype
         fp16 = self.config.fp16
@@ -626,6 +638,12 @@ class Engine:
                 self._rltd_value = v
                 self.module.config.random_ltd_current = v
                 self._train_batch_fn = None  # retrace at the new keep count
+        if self.progressive_layer_drop is not None:
+            # θ rides the batch as a traced scalar — it decays every step and
+            # must never trigger a retrace (reference: PLD state dict merged
+            # into the module kwargs, progressive_layer_drop.py get_state)
+            theta = self.progressive_layer_drop.update_state(self.global_steps)
+            batch = {**batch, "pld_theta": jnp.asarray(theta, jnp.float32)}
         if self._train_batch_fn is None and self.offload_device is None:
             self._train_batch_fn = self._build_train_batch_fn()
         gas = self.config.gradient_accumulation_steps

@@ -1,0 +1,39 @@
+"""Progressive Layer Dropping (PLD) — compressed-training layer-drop schedule.
+
+Analog of the reference's ``runtime/progressive_layer_drop.py:10``
+(PLD, arXiv:2010.13369): the keep-probability schedule
+``θ(t) = (1 − θ̄)·e^(−γ·t) + θ̄`` starts at 1 (keep everything) and decays
+toward the configured floor ``θ̄``; depth scales the per-layer keep
+probability ``p_l = 1 − (l+1)/L · (1 − θ(t))`` so late layers drop more.
+
+The schedule lives host-side; the engine injects the current θ into each
+batch as a traced scalar (``batch["pld_theta"]``) so no retracing happens as
+θ decays, and the model's layer scan skips dropped layers with ``lax.cond``
+— a dropped layer costs neither FLOPs nor memory that step.
+"""
+import math
+from typing import Any, Dict
+
+from ..utils.logging import log_dist
+
+__all__ = ["ProgressiveLayerDrop"]
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = float(theta)
+        self.gamma = float(gamma)
+        self.current_theta = 1.0
+        log_dist(f"Enabled progressive layer dropping (theta = {self.theta})")
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = ((1.0 - self.theta)
+                              * math.exp(-self.gamma * global_step)
+                              + self.theta)
+        return self.current_theta

@@ -65,5 +65,24 @@ def tiled_linear(x: jnp.ndarray, w: jnp.ndarray,
     return out
 
 
-# class-style alias mirroring the reference surface (TiledLinear module)
-TiledLinear = tiled_linear
+class TiledLinear:
+    """Module-style surface matching the reference's ``TiledLinear(in_f,
+    out_f, ...)`` constructor: owns its weight/bias and applies
+    :func:`tiled_linear` on call."""
+
+    def __init__(self, in_features: int, out_features: int,
+                 in_splits: int = 1, out_splits: int = 1, bias: bool = True,
+                 seed: int = 0, init_scale: float = 0.02):
+        import jax.random as jrandom
+
+        self.in_splits = in_splits
+        self.out_splits = out_splits
+        k = jrandom.PRNGKey(seed)
+        self.weight = jrandom.normal(
+            k, (in_features, out_features), jnp.float32) * init_scale
+        self.bias = jnp.zeros((out_features,), jnp.float32) if bias else None
+
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        return tiled_linear(x, self.weight, self.bias,
+                            in_splits=self.in_splits,
+                            out_splits=self.out_splits)

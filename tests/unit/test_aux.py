@@ -352,3 +352,124 @@ class TestSpatialAndTiling:
 
         with pytest.raises(ValueError):
             tiled_linear(jnp.ones((2, 32)), jnp.ones((32, 16)), in_splits=5)
+
+
+class TestPLDAndEigenvalue:
+    """Progressive layer drop (reference runtime/progressive_layer_drop.py)
+    and the Hessian power-iteration estimator (runtime/eigenvalue.py)."""
+
+    def test_pld_theta_schedule(self):
+        from deepspeedsyclsupport_tpu.runtime.progressive_layer_drop import (
+            ProgressiveLayerDrop)
+
+        pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+        assert pld.get_theta() == 1.0
+        t0 = pld.update_state(0)
+        t100 = pld.update_state(100)
+        t_inf = pld.update_state(10_000_000)
+        assert t0 == 1.0 and t100 < t0 and abs(t_inf - 0.5) < 1e-6
+        assert pld.get_state()["progressive_layer_drop"] is True
+
+    def test_pld_engine_trains_and_drops(self):
+        """With theta forced low, PLD must change the loss trajectory (layers
+        actually drop) while remaining finite; eval path is unaffected."""
+        from deepspeedsyclsupport_tpu.models import build_model
+
+        model = build_model("tiny", dtype="float32")
+        cfg = simple_config(progressive_layer_drop={
+            "enabled": True, "theta": 0.1, "gamma": 100.0})  # θ ≈ 0.1 fast
+        engine, *_ = dstpu.initialize(model=model, config=cfg)
+        ids = np.random.RandomState(0).randint(
+            0, model.config.vocab_size, (2, 16)).astype(np.int32)
+        m = engine.train_batch({"input_ids": ids})   # step 0: θ(0) = 1.0
+        assert np.isfinite(float(np.asarray(m["loss"])))
+        assert engine.progressive_layer_drop.get_theta() == 1.0
+        m = engine.train_batch({"input_ids": ids})   # step 1: θ ≈ 0.1
+        assert np.isfinite(float(np.asarray(m["loss"])))
+        assert engine.progressive_layer_drop.get_theta() < 0.11
+
+        # dropped-layer forward differs from the full forward
+        params = engine.params
+        full, _, _ = model._forward(params, jnp.asarray(ids))
+        dropped, _, _ = model._forward(
+            params, jnp.asarray(ids),
+            pld_theta=jnp.float32(0.01), rng=jax.random.PRNGKey(1))
+        assert float(np.abs(np.asarray(full - dropped)).max()) > 1e-6
+
+    def test_eigenvalue_quadratic_exact(self):
+        """For a quadratic loss ½xᵀAx the Hessian is A — power iteration must
+        recover A's top eigenvalue."""
+        from deepspeedsyclsupport_tpu.utils.eigenvalue import Eigenvalue
+
+        evals = np.array([5.0, 2.0, 0.5, 0.1], np.float32)
+        rng = np.random.RandomState(0)
+        Q, _ = np.linalg.qr(rng.randn(4, 4).astype(np.float32))
+        A = jnp.asarray(Q @ np.diag(evals) @ Q.T)
+
+        def loss(p, batch):
+            x = p["x"]
+            return 0.5 * x @ A @ x
+
+        est = Eigenvalue(max_iter=200, tol=1e-5).compute_eigenvalue(
+            loss, {"x": jnp.ones((4,))}, None)
+        assert abs(est - 5.0) < 1e-2
+
+    def test_eigenvalue_per_block(self):
+        from deepspeedsyclsupport_tpu.utils.eigenvalue import Eigenvalue
+
+        def loss(p, batch):
+            return 3.0 * jnp.sum(p["a"]["w"] ** 2) + 0.5 * jnp.sum(
+                p["b"]["w"] ** 2)
+
+        params = {"a": {"w": jnp.ones((3,))}, "b": {"w": jnp.ones((3,))}}
+        out = Eigenvalue(max_iter=100, tol=1e-6).compute_per_block(
+            loss, params, None, ["a", "b"])
+        assert abs(out["a"] - 6.0) < 1e-3   # Hessian diag = 2·coef
+        assert abs(out["b"] - 1.0) < 1e-3
+
+
+def test_pld_applies_on_unrolled_layer_loop():
+    """PLD must engage on the non-scan (unrolled) layer path too."""
+    from deepspeedsyclsupport_tpu.models import build_model
+
+    model = build_model("tiny", dtype="float32", scan_layers=False)
+    params = model.init_params(jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 512, (2, 16)))
+    full, _, _ = model._forward(params, ids)
+    dropped, _, _ = model._forward(params, ids, pld_theta=jnp.float32(0.01),
+                                   rng=jax.random.PRNGKey(1))
+    assert float(np.abs(np.asarray(full - dropped)).max()) > 1e-6
+
+
+def test_pld_rejects_random_ltd_combo():
+    from deepspeedsyclsupport_tpu.models import build_model
+
+    model = build_model("tiny")
+    cfg = simple_config(
+        progressive_layer_drop={"enabled": True},
+        data_efficiency={"enabled": True, "data_routing": {"random_ltd": {
+            "enabled": True, "random_ltd_schedule": {
+                "min_value": 8, "max_value": 16,
+                "schedule_config": {"seq_per_step": 16}}}}})
+    with pytest.raises(ValueError):
+        dstpu.initialize(model=model, config=cfg)
+
+
+def test_tiled_linear_module_surface():
+    from deepspeedsyclsupport_tpu.runtime.tiling import TiledLinear
+
+    layer = TiledLinear(32, 16, in_splits=2, out_splits=2)
+    out = layer(jnp.ones((4, 32)))
+    assert out.shape == (4, 16)
+    want = jnp.ones((4, 32)) @ layer.weight + layer.bias
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_longformer_index_length_mismatch_rejected():
+    from deepspeedsyclsupport_tpu.ops.sparse_attention import (
+        BSLongformerSparsityConfig)
+
+    with pytest.raises(ValueError):
+        BSLongformerSparsityConfig(4, global_block_indices=[0, 8],
+                                   global_block_end_indices=[2])
