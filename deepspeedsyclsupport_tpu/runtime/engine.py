@@ -638,18 +638,23 @@ class Engine:
                 self._rltd_value = v
                 self.module.config.random_ltd_current = v
                 self._train_batch_fn = None  # retrace at the new keep count
-        if self.progressive_layer_drop is not None:
-            # θ rides the batch as a traced scalar — it decays every step and
-            # must never trigger a retrace (reference: PLD state dict merged
-            # into the module kwargs, progressive_layer_drop.py get_state)
-            theta = self.progressive_layer_drop.update_state(self.global_steps)
-            batch = {**batch, "pld_theta": jnp.asarray(theta, jnp.float32)}
         if self._train_batch_fn is None and self.offload_device is None:
             self._train_batch_fn = self._build_train_batch_fn()
         gas = self.config.gradient_accumulation_steps
         if gas > 1:
             batch = jax.tree_util.tree_map(
                 lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]), batch)
+        if self.progressive_layer_drop is not None:
+            # θ rides the batch as a traced scalar — it decays every step and
+            # must never trigger a retrace (reference: PLD state dict merged
+            # into the module kwargs, progressive_layer_drop.py get_state).
+            # Injected AFTER the accumulation reshape: under gas>1 the scan
+            # slices a (gas,) vector down to the per-microbatch scalar
+            theta = self.progressive_layer_drop.update_state(self.global_steps)
+            t = jnp.asarray(theta, jnp.float32)
+            batch = {**batch,
+                     "pld_theta": jnp.broadcast_to(t, (gas,)) if gas > 1
+                     else t}
         self.tput_timer.start()
         rng = jax.random.fold_in(self._rng, self.global_steps)
         if self.offload_device is not None:

@@ -40,6 +40,7 @@ class HybridEngine(Engine):
                 "protocol: decode_step/init_kv_cache)")
         self._inf_cfg = DSTpuInferenceConfig.from_config(inference_config)
         self._inf_engine = None
+        self._merge_fn = None  # jitted LoRA fuse (built on first generate)
         self._training = True
         self.generate_time = 0.0
         self.train_time = 0.0
@@ -77,7 +78,22 @@ class HybridEngine(Engine):
         # live training params, cast to the training compute dtype (the same
         # cast the train step applies — generation sees exactly the weights
         # training uses, the invariant RLHF needs)
-        self._inf_engine.params = self._cast_params(self.params)
+        from .lora import LoRAModel
+
+        if isinstance(self.module, LoRAModel):
+            # LoRA fuse (reference _fuse_lora, hybrid_engine.py:138): merge
+            # adapters into the base ONCE per generate call, so the decode
+            # loop runs on plain fused weights instead of recomputing
+            # base + scale·A·B every step; nothing to unfuse (pure merge)
+            if self._merge_fn is None:
+                # base passed as an ARGUMENT: jitting self.module.merge
+                # would bake the whole frozen tree into the executable
+                self._merge_fn = jax.jit(self.module.merge_with)
+            self._inf_engine.module = self.module.model
+            self._inf_engine.params = self._cast_params(
+                self._merge_fn(self.module.base_params, self.params))
+        else:
+            self._inf_engine.params = self._cast_params(self.params)
         out = self._inf_engine.generate(input_ids, **kwargs)
         self.generate_time = time.perf_counter() - t0
         return out

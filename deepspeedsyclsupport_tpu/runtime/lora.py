@@ -1,0 +1,135 @@
+"""LoRA adapters — low-rank fine-tuning with fuse-for-generate.
+
+Analog of the reference hybrid engine's LoRA handling
+(``runtime/hybrid_engine.py:138-160`` ``_fuse_lora``/``_unfuse_lora``: merge
+``W += scale·B·A`` into the base weight before fast generation, subtract it
+back before training) and of the PEFT-style adapters DeepSpeed-Chat trains.
+
+Functional recast: the base pytree is FROZEN and closed over; the trainable
+tree the engine sees is only the adapters, so "unfuse" never exists —
+training differentiates through ``W_eff = W + scale·A·B`` recomputed inside
+the jitted step, and "fuse" is a pure jitted merge producing the effective
+weights once per generate phase (the reference's fuse, without the in-place
+surgery or the possibility of forgetting to unfuse).
+"""
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+__all__ = ["LoRAConfig", "LoRAModel"]
+
+
+@dataclass
+class LoRAConfig:
+    r: int = 8
+    alpha: float = 16.0
+    # leaf-path suffixes to adapt (default: attention projections, the
+    # DeepSpeed-Chat / LoRA-paper default)
+    target_patterns: Tuple[str, ...] = ("attn/wq", "attn/wk", "attn/wv",
+                                        "attn/wo")
+    init_std: float = 0.02
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.r
+
+
+def _path_str(kp) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "name", k)))
+                    for k in kp)
+
+
+class LoRAModel:
+    """Wrap a loss-protocol model: ``init_params`` returns ONLY the adapter
+    tree; the engine trains it while the base stays frozen. ``merge`` builds
+    the fused full-weight pytree for generation."""
+
+    def __init__(self, model: Any, base_params: Params, config: LoRAConfig):
+        self.model = model
+        self.config = model.config  # engine/infra pass-through
+        self.lora_config = config
+        self.base_params = base_params
+        self._targets = []
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(base_params)[0]:
+            path = _path_str(kp)
+            if any(path.endswith(t) for t in config.target_patterns):
+                if jnp.ndim(leaf) not in (2, 3):
+                    raise ValueError(f"LoRA target {path} has rank "
+                                     f"{jnp.ndim(leaf)}; need 2-D (or "
+                                     f"stacked [L, in, out]) matrices")
+                self._targets.append(path)
+        if not self._targets:
+            raise ValueError(f"no leaves matched {config.target_patterns}")
+
+    # ------------------------------------------------------------------ params
+    def init_params(self, rng: Optional[jax.Array] = None) -> Params:
+        cfg = self.lora_config
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        flat = jax.tree_util.tree_flatten_with_path(self.base_params)[0]
+        out: Params = {}
+        ks = iter(jax.random.split(rng, len(self._targets) + 1))
+        for kp, leaf in flat:
+            path = _path_str(kp)
+            if path not in self._targets:
+                continue
+            shape = jnp.shape(leaf)
+            # stacked scan layers carry a leading [L] dim
+            lead, (n_in, n_out) = shape[:-2], shape[-2:]
+            out[path] = {
+                # A ~ N(0, σ), B = 0 → adapters start as an exact no-op
+                "A": jax.random.normal(next(ks), lead + (n_in, cfg.r),
+                                       jnp.float32) * cfg.init_std,
+                "B": jnp.zeros(lead + (cfg.r, n_out), jnp.float32),
+            }
+        return out
+
+    # ------------------------------------------------------------------- merge
+    def merge_with(self, base_params: Params, lora_params: Params) -> Params:
+        """Fused full weights: ``base + scale·A·B`` at every target (the
+        reference ``_fuse_lora``; pure, so there is nothing to unfuse). Both
+        trees are explicit arguments so callers can jit WITHOUT baking the
+        base weights into the executable as constants."""
+        scale = self.lora_config.scale
+
+        def fuse(kp, leaf):
+            path = _path_str(kp)
+            ab = lora_params.get(path)
+            if ab is None:
+                return leaf
+            delta = jnp.einsum("...ir,...ro->...io", ab["A"], ab["B"])
+            return (leaf + scale * delta).astype(leaf.dtype)
+
+        return jax.tree_util.tree_map_with_path(fuse, base_params)
+
+    def merge(self, lora_params: Params) -> Params:
+        return self.merge_with(self.base_params, lora_params)
+
+    # ----------------------------------------------------- engine protocol
+    def loss(self, lora_params: Params, batch: Dict[str, Any],
+             rng: Optional[jax.Array] = None, train: bool = True):
+        return self.model.loss(self.merge(lora_params), batch, rng=rng,
+                               train=train)
+
+    def apply(self, lora_params: Params, input_ids, **kw):
+        return self.model.apply(self.merge(lora_params), input_ids, **kw)
+
+    def sharding_rules(self, path, shape):
+        return None  # adapters are tiny: replicate
+
+    # generation protocol delegates through the merged weights
+    def init_kv_cache(self, *a, **kw):
+        return self.model.init_kv_cache(*a, **kw)
+
+    def decode_step(self, lora_params: Params, cache, tokens, **kw):
+        return self.model.decode_step(self.merge(lora_params), cache,
+                                      tokens, **kw)
+
+    def num_adapter_params(self) -> int:
+        import numpy as np
+
+        return sum(int(np.prod(np.shape(l))) for l in
+                   jax.tree_util.tree_leaves(self.init_params()))

@@ -65,9 +65,10 @@ class Eigenvalue:
 
         rng = jax.random.PRNGKey(self.seed)
         ks = jax.random.split(rng, len(flat))
+        # tangents must match the primal dtypes (bf16/fp16 models)
         v = jax.tree_util.tree_unflatten(
-            treedef, [jax.random.normal(k, jnp.shape(p), jnp.float32)
-                      if a else jnp.zeros(jnp.shape(p), jnp.float32)
+            treedef, [jax.random.normal(k, jnp.shape(p), jnp.result_type(p))
+                      if a else jnp.zeros(jnp.shape(p), jnp.result_type(p))
                       for k, (_, p), a in zip(ks, flat, active)])
         nrm0 = _tree_norm(v)
         v = jax.tree_util.tree_map(lambda x: x / nrm0, v)
@@ -96,7 +97,8 @@ class Eigenvalue:
             def fltr(kp, prefix=prefix):
                 path = "/".join(str(getattr(k, "key", getattr(k, "name", k)))
                                 for k in kp)
-                return path.startswith(prefix)
+                # separator-aware: 'layers/1' must not match 'layers/10'
+                return path == prefix or path.startswith(prefix + "/")
 
             out[prefix] = self.compute_eigenvalue(loss_fn, params, batch,
                                                   filter_fn=fltr)

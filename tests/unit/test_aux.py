@@ -473,3 +473,22 @@ def test_longformer_index_length_mismatch_rejected():
     with pytest.raises(ValueError):
         BSLongformerSparsityConfig(4, global_block_indices=[0, 8],
                                    global_block_end_indices=[2])
+
+
+def test_pld_with_gradient_accumulation():
+    """Regression: the injected pld_theta scalar must survive the gas>1
+    microbatch reshape (it rides as a (gas,) vector sliced by the scan)."""
+    from deepspeedsyclsupport_tpu.models import build_model
+
+    model = build_model("tiny", dtype="float32")
+    cfg = simple_config(progressive_layer_drop={"enabled": True,
+                                                "theta": 0.5, "gamma": 0.1},
+                        gradient_accumulation_steps=2,
+                        train_micro_batch_size_per_gpu=1)
+    engine, *_ = dstpu.initialize(model=model, config=cfg)
+    ids = np.random.RandomState(0).randint(
+        0, model.config.vocab_size,
+        (engine.train_batch_size(), 16)).astype(np.int32)
+    for _ in range(2):
+        m = engine.train_batch({"input_ids": ids})
+    assert np.isfinite(float(np.asarray(m["loss"])))
