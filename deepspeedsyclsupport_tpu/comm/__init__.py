@@ -1,7 +1,7 @@
 from .comm import (
     all_gather,
     all_reduce,
-    all_to_all,
+    all_to_all, hierarchical_all_to_all,
     axis_index,
     axis_size,
     barrier,

@@ -32,7 +32,7 @@ from jax import lax
 from .comms_logging import comms_logger
 
 __all__ = [
-    "all_reduce", "all_gather", "reduce_scatter", "all_to_all", "ppermute",
+    "all_reduce", "all_gather", "reduce_scatter", "all_to_all", "hierarchical_all_to_all", "ppermute",
     "broadcast", "pmean", "axis_size", "axis_index", "send_recv_next",
     "send_recv_prev", "init_distributed", "is_initialized", "barrier",
     "get_world_size", "get_rank", "get_local_rank", "get_device_count",
@@ -113,6 +113,53 @@ def all_to_all(x, axis_name, split_axis: int, concat_axis: int, tiled: bool = Tr
     _log("all_to_all", axis_name, x)
     return lax.all_to_all(x, axis_name, split_axis=split_axis,
                           concat_axis=concat_axis, tiled=tiled)
+
+
+def hierarchical_all_to_all(x, axis_name, group_size: int,
+                            split_axis: int = 0, concat_axis: int = 0):
+    """Two-hop all-to-all: intra-group exchange first, then inter-group.
+
+    Drop-in equivalent of ``all_to_all(x, axis, split, concat, tiled=True)``
+    decomposed the way the reference's hierarchical MoE dispatch does it
+    (``utils/groups.py:356`` ``_get_local_all_to_all_group``): with N ranks
+    in groups of ``group_size`` (a TPU slice / a node), every rank first
+    exchanges within its group over fast links (ICI), then one exchange
+    crosses groups (DCN) — cross-group messages per device drop from
+    ``N − group_size`` to ``N / group_size − 1``, which is what makes MoE
+    routing viable across slices.
+    """
+    if _off("ALL_TO_ALL"):
+        return x
+    n = lax.axis_size(axis_name)
+    gs = int(group_size)
+    if n % gs:
+        raise ValueError(f"axis size {n} not divisible by group_size {gs}")
+    ng = n // gs
+    if gs == 1 or ng == 1:
+        return all_to_all(x, axis_name, split_axis, concat_axis, tiled=True)
+    _log("hierarchical_all_to_all", axis_name, x)
+    if x.shape[split_axis] % n:
+        raise ValueError(f"split dim {x.shape[split_axis]} not divisible "
+                         f"by axis size {n}")
+    # parts [tg, tl, ...]: chunk (tg, tl) is destined for rank tg·gs + tl
+    parts = jnp.moveaxis(
+        x.reshape(x.shape[:split_axis] + (n, x.shape[split_axis] // n)
+                  + x.shape[split_axis + 1:]), split_axis, 0)
+    parts = parts.reshape((ng, gs) + parts.shape[1:])
+    intra = [[g * gs + l for l in range(gs)] for g in range(ng)]
+    inter = [[g * gs + l for g in range(ng)] for l in range(gs)]
+    # hop 1 (ICI): z[tg, sl, ...] = source (G, sl)'s chunk (tg, my_l)
+    z = lax.all_to_all(parts, axis_name, split_axis=1, concat_axis=1,
+                       axis_index_groups=intra)
+    # hop 2 (DCN): w[sg, sl, ...] = source (sg, sl)'s chunk (my_g, my_l)
+    w = lax.all_to_all(z, axis_name, split_axis=0, concat_axis=0,
+                       axis_index_groups=inter)
+    w = w.reshape((n,) + w.shape[2:])           # source-major, = plain a2a
+    out = jnp.moveaxis(w, 0, concat_axis)
+    return out.reshape(out.shape[:concat_axis]
+                       + (out.shape[concat_axis]
+                          * out.shape[concat_axis + 1],)
+                       + out.shape[concat_axis + 2:])
 
 
 def ppermute(x, axis_name, perm: Sequence[tuple]):

@@ -56,7 +56,8 @@ class LoRAModel:
         self._targets = []
         for kp, leaf in jax.tree_util.tree_flatten_with_path(base_params)[0]:
             path = _path_str(kp)
-            if any(path.endswith(t) for t in config.target_patterns):
+            if any(path == t or path.endswith("/" + t)
+                   for t in config.target_patterns):
                 if jnp.ndim(leaf) not in (2, 3):
                     raise ValueError(f"LoRA target {path} has rank "
                                      f"{jnp.ndim(leaf)}; need 2-D (or "

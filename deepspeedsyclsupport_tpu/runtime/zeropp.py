@@ -237,6 +237,10 @@ def build_zeropp_train_fn(engine):
     def make_batch_spec(x):
         nd = np.ndim(x)
         lead = (None, batch_spec[0]) if gas > 1 else (batch_spec[0],)
+        if nd < len(lead):
+            # scalar side-channels riding the batch (e.g. pld_theta: () or
+            # a (gas,) vector) replicate — they carry no batch dimension
+            return P(*([None] * nd))
         return P(*lead, *([None] * (nd - len(lead))))
 
     def fn(params, opt_state, scaler, batch, rng):

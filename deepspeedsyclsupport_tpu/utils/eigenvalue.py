@@ -21,8 +21,10 @@ __all__ = ["Eigenvalue"]
 
 
 def _tree_dot(a, b):
-    return sum(jnp.vdot(x, y) for x, y in
-               zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)))
+    # accumulate in fp32: fp16/bf16 trees overflow/underflow their own dtype
+    return sum(jnp.vdot(x.astype(jnp.float32), y.astype(jnp.float32))
+               for x, y in zip(jax.tree_util.tree_leaves(a),
+                               jax.tree_util.tree_leaves(b)))
 
 
 def _tree_norm(a):
@@ -71,7 +73,10 @@ class Eigenvalue:
                       if a else jnp.zeros(jnp.shape(p), jnp.result_type(p))
                       for k, (_, p), a in zip(ks, flat, active)])
         nrm0 = _tree_norm(v)
-        v = jax.tree_util.tree_map(lambda x: x / nrm0, v)
+        # divide in fp32, cast back: mixed-dtype trees must keep each
+        # tangent leaf's dtype equal to its primal's
+        v = jax.tree_util.tree_map(
+            lambda x: (x.astype(jnp.float32) / nrm0).astype(x.dtype), v)
 
         hvp_j = jax.jit(lambda v: mask(hvp(v)))
         prev = 0.0
@@ -80,8 +85,9 @@ class Eigenvalue:
             hv = hvp_j(v)
             eig = float(_tree_dot(v, hv).real)  # Rayleigh quotient
             nrm = _tree_norm(hv)
-            v = jax.tree_util.tree_map(lambda x: x / (nrm + self.stability),
-                                       hv)
+            v = jax.tree_util.tree_map(
+                lambda x: (x.astype(jnp.float32)
+                           / (nrm + self.stability)).astype(x.dtype), hv)
             if it > 0 and abs(eig) > 0 and \
                     abs(eig - prev) / abs(eig) < self.tol:
                 break

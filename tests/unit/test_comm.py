@@ -119,3 +119,67 @@ def test_shift_no_wrap(topo):
     out = _smap(topo, lambda v: dist.send_recv_prev(v, "data", wrap=False),
                 P("data"), P("data"))(x)
     np.testing.assert_allclose(np.asarray(out), [2., 3., 4., 5., 6., 7., 8., 0.])
+
+
+class TestHierarchicalAllToAll:
+    """Two-hop a2a (reference utils/groups.py:356 hierarchical MoE groups):
+    must be bit-equivalent to the flat all_to_all for every group size."""
+
+    @pytest.mark.parametrize("group_size", [1, 2, 4, 8])
+    def test_matches_flat_all_to_all(self, mesh8, group_size):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        import deepspeedsyclsupport_tpu.comm as dist
+
+        topo = mesh8
+        x = jnp.arange(8 * 16 * 4, dtype=jnp.float32).reshape(8, 16, 4)
+
+        def flat(v):
+            return dist.all_to_all(v, "data", split_axis=1, concat_axis=0)
+
+        def hier(v):
+            return dist.hierarchical_all_to_all(v, "data", group_size,
+                                                split_axis=1, concat_axis=0)
+
+        kw = dict(mesh=topo.mesh, in_specs=P("data"), out_specs=P("data"),
+                  check_vma=False)
+        a = jax.shard_map(flat, **kw)(x)
+        b = jax.shard_map(hier, **kw)(x)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_same_axes_roundtrip(self, mesh8):
+        """a2a then inverse a2a over (split,concat) swapped returns input."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        import deepspeedsyclsupport_tpu.comm as dist
+
+        topo = mesh8
+        x = jax.random.normal(jax.random.PRNGKey(0), (8, 8, 4))
+
+        def rt(v):
+            y = dist.hierarchical_all_to_all(v, "data", 4, split_axis=1,
+                                             concat_axis=0)
+            return dist.hierarchical_all_to_all(y, "data", 4, split_axis=0,
+                                                concat_axis=1)
+
+        out = jax.shard_map(rt, mesh=topo.mesh, in_specs=P("data"),
+                            out_specs=P("data"), check_vma=False)(x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x),
+                                   rtol=1e-6)
+
+    def test_indivisible_group_rejected(self, mesh8):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        import deepspeedsyclsupport_tpu.comm as dist
+
+        topo = mesh8
+        x = jnp.ones((8, 8))
+        with pytest.raises(ValueError):
+            jax.shard_map(
+                lambda v: dist.hierarchical_all_to_all(v, "data", 3,
+                                                       split_axis=1),
+                mesh=topo.mesh, in_specs=P("data"), out_specs=P("data"),
+                check_vma=False)(x)

@@ -138,3 +138,23 @@ class TestGuards:
         engine2, _, _, _ = dstpu.initialize(model=model, config=cfg)
         engine2.load_checkpoint(str(tmp_path))
         assert engine2.global_steps == engine.global_steps
+
+
+class TestZeroPPWithScalarBatchLeaves:
+    """Regression: scalar side-channel batch leaves (pld_theta) must map to
+    replicated specs in the explicit shard_map step, not batch-sharded."""
+
+    @pytest.mark.parametrize("gas", [1, 2])
+    def test_pld_theta_rides_zeropp_step(self, gas):
+        model = SimpleModel(hidden_dim=128)
+        cfg = simple_config(
+            zero_optimization={"stage": 3, "zero_quantized_weights": True},
+            progressive_layer_drop={"enabled": True},
+            gradient_accumulation_steps=gas,
+            train_micro_batch_size_per_gpu=2)
+        engine, *_ = dstpu.initialize(model=model, config=cfg)
+        data = random_dataset(engine.train_batch_size(), hidden_dim=128,
+                              n_batches=2)
+        for b in data:
+            m = engine.train_batch(b)
+        assert np.isfinite(float(np.asarray(m["loss"])))
