@@ -65,6 +65,69 @@ class InferenceEngineV2:
                  f"tokens, budget {cfg.max_tokens_per_batch} tok/fwd, "
                  f"≤{cfg.max_sequences} seqs")
 
+    # ----------------------------------------------------------- persistence
+    def serialize(self, save_path: str) -> None:
+        """Model snapshot (reference ``engine_v2.serialize:237``: flattened
+        params + metadata + pickled config): the placed (de-quantized if
+        ZeRO-Inference was on) parameter tree plus both configs, reloadable
+        with :meth:`deserialize` into a fresh engine."""
+        import dataclasses
+
+        from ...checkpoint.engine import save_tree
+
+        params = self.params
+        if self.config.quantize_weights and "layers" in params:
+            from ...compression.quantize import dequantize_tree
+
+            params = dict(params)
+            params["layers"] = jax.jit(
+                lambda t: dequantize_tree(t, jnp.dtype(self.config.dtype))
+            )(params["layers"])
+        from ...models.config import ModelConfig
+
+        if not isinstance(getattr(self.model, "config", None), ModelConfig):
+            raise TypeError(
+                f"serialize() supports models carrying a ModelConfig "
+                f"(models.CausalLM family); got {type(self.model).__name__} "
+                f"— fail at save, not with a confusing load-time error")
+        eng_cfg = dataclasses.asdict(self.config)
+        eng_cfg["dtype"] = str(jnp.dtype(eng_cfg["dtype"]))  # JSON-safe
+        meta = {"model_class": type(self.model).__name__,
+                "model_config": dataclasses.asdict(self.model.config),
+                "engine_config": eng_cfg}
+        save_tree(save_path, {"params": params}, meta)
+        log_dist(f"serialized ragged engine model to {save_path}")
+
+    @classmethod
+    def deserialize(cls, save_path: str,
+                    topology: Optional[MeshTopology] = None,
+                    **config_overrides) -> "InferenceEngineV2":
+        """Rebuild an engine from :meth:`serialize` output (the reference
+        pairs this with its pickled ``ds_model_config``)."""
+        import json as _json
+        import os as _os
+
+        from ...checkpoint.engine import META_FILE, load_tree
+        from ...models.config import ModelConfig
+        from ...models.transformer import CausalLM
+
+        with open(_os.path.join(save_path, META_FILE)) as f:
+            meta = _json.load(f)
+        cls_name = meta.get("model_class", "CausalLM")
+        if cls_name != "CausalLM":
+            raise TypeError(f"snapshot was serialized from {cls_name}; "
+                            f"deserialize() rebuilds CausalLM models only")
+        model = CausalLM(ModelConfig(**meta["model_config"]))
+        example = jax.eval_shape(model.init_params, jax.random.PRNGKey(0))
+        # default-device placement; __init__ re-places onto the serving mesh
+        dev = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        sh = jax.tree_util.tree_map(lambda _: dev, example)
+        state, _ = load_tree(save_path, {"params": (example, sh)})
+        eng_cfg = dict(meta.get("engine_config", {}))
+        eng_cfg.update(config_overrides)
+        return cls(model, state["params"], config=eng_cfg,
+                   topology=topology)
+
     # ------------------------------------------------------------- scheduling
     def can_schedule(self, uids: Sequence[int],
                      lengths: Sequence[int]) -> bool:

@@ -90,13 +90,13 @@ class HybridEngine(Engine):
                 # would bake the whole frozen tree into the executable
                 self._merge_fn = jax.jit(self.module.merge_with)
             self._inf_engine.module = self.module.model
-            # NO compute-dtype cast here: LoRA training computes its forward
-            # on the f32 merged weights (LoRAModel.loss merges then the
-            # model casts activations), so generation must read the SAME
-            # merged tree or rollout logits diverge from training logits —
-            # the RLHF importance-ratio invariant
-            self._inf_engine.params = self._merge_fn(self.module.base_params,
-                                                     self.params)
+            # cast the ADAPTERS before merging — exactly what the train step
+            # does (_loss_and_metrics casts params, then LoRAModel.loss
+            # merges into the uncast base), so generation reads the same
+            # merged weights training computes with — the RLHF
+            # importance-ratio invariant
+            self._inf_engine.params = self._merge_fn(
+                self.module.base_params, self._cast_params(self.params))
         else:
             self._inf_engine.params = self._cast_params(self.params)
         out = self._inf_engine.generate(input_ids, **kwargs)

@@ -374,3 +374,28 @@ class TestArchZooServing:
         prompts = [[7, 3, 11, 8, 2, 90, 17, 44]]
         got = eng.generate(prompts, max_new_tokens=5)
         assert got[0] == _naive_greedy(model, params, prompts[0], 5)
+
+
+class TestSerialize:
+    """Engine snapshot round-trip (reference engine_v2.serialize:237)."""
+
+    def test_serialize_deserialize_logits_match(self, tiny, tmp_path):
+        model, params = tiny
+        eng = _v2(model, params)
+        prompt = [1, 5, 9, 200, 3]
+        want = eng.put([1], [prompt])[1]
+        eng.serialize(str(tmp_path / "snap"))
+        eng2 = InferenceEngineV2.deserialize(str(tmp_path / "snap"))
+        assert eng2.config.block_size == eng.config.block_size
+        got = eng2.put([1], [prompt])[1]
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_serialize_dequantizes_zero_inference(self, tiny, tmp_path):
+        model, params = tiny
+        eng = _v2(model, params, quantize_weights=True)
+        eng.serialize(str(tmp_path / "qsnap"))
+        eng2 = InferenceEngineV2.deserialize(str(tmp_path / "qsnap"))
+        prompt = [7, 3, 11]
+        a = eng.put([1], [prompt])[1]
+        b = eng2.put([1], [prompt])[1]
+        np.testing.assert_allclose(a, b, rtol=5e-2, atol=5e-2)
