@@ -75,6 +75,13 @@ class InferenceEngineV2:
 
         from ...checkpoint.engine import save_tree
 
+        from ...models.config import ModelConfig
+
+        if not isinstance(getattr(self.model, "config", None), ModelConfig):
+            raise TypeError(
+                f"serialize() supports models carrying a ModelConfig "
+                f"(models.CausalLM family); got {type(self.model).__name__} "
+                f"— fail at save, not with a confusing load-time error")
         params = self.params
         if self.config.quantize_weights and "layers" in params:
             from ...compression.quantize import dequantize_tree
@@ -83,13 +90,6 @@ class InferenceEngineV2:
             params["layers"] = jax.jit(
                 lambda t: dequantize_tree(t, jnp.dtype(self.config.dtype))
             )(params["layers"])
-        from ...models.config import ModelConfig
-
-        if not isinstance(getattr(self.model, "config", None), ModelConfig):
-            raise TypeError(
-                f"serialize() supports models carrying a ModelConfig "
-                f"(models.CausalLM family); got {type(self.model).__name__} "
-                f"— fail at save, not with a confusing load-time error")
         eng_cfg = dataclasses.asdict(self.config)
         eng_cfg["dtype"] = str(jnp.dtype(eng_cfg["dtype"]))  # JSON-safe
         meta = {"model_class": type(self.model).__name__,
@@ -119,9 +119,14 @@ class InferenceEngineV2:
                             f"deserialize() rebuilds CausalLM models only")
         model = CausalLM(ModelConfig(**meta["model_config"]))
         example = jax.eval_shape(model.init_params, jax.random.PRNGKey(0))
-        # default-device placement; __init__ re-places onto the serving mesh
-        dev = jax.sharding.SingleDeviceSharding(jax.devices()[0])
-        sh = jax.tree_util.tree_map(lambda _: dev, example)
+        # sharded restore: leaves stream straight onto the serving mesh (the
+        # resharding-on-load path) — never staged whole on one device
+        from ...runtime import zero as zero_lib
+
+        topology = topology or build_topology(dp=-1)
+        sh = zero_lib.tree_param_shardings(
+            example, topology, stage=0,
+            extra_rules=getattr(model, "sharding_rules", None))
         state, _ = load_tree(save_path, {"params": (example, sh)})
         eng_cfg = dict(meta.get("engine_config", {}))
         eng_cfg.update(config_overrides)

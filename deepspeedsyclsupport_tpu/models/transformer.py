@@ -215,7 +215,12 @@ class CausalLM:
 
         if cfg.remat:
             policy = None
-            if cfg.remat_policy and cfg.remat_policy != "nothing_saveable":
+            if cfg.remat_policy == "offload_dots_to_host":
+                # activation offload (reference cpu_checkpointing): saved
+                # dots land in pinned host memory instead of HBM
+                policy = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+                    "device", "pinned_host")
+            elif cfg.remat_policy and cfg.remat_policy != "nothing_saveable":
                 policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
             layer_fn = jax.checkpoint(layer_fn, policy=policy)
 

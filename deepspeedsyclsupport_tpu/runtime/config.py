@@ -227,6 +227,7 @@ class ActivationCheckpointingConfig:
     # save_only_these_names) would be silently misused as policies
     VALID_POLICIES = ("nothing_saveable", "everything_saveable",
                       "dots_saveable", "checkpoint_dots",
+                      "offload_dots_to_host",
                       "dots_with_no_batch_dims_saveable",
                       "checkpoint_dots_with_no_batch_dims")
 

@@ -294,8 +294,18 @@ class Engine:
                     "not expose a remat flag; apply jax.checkpoint in your "
                     "model instead")
             if ac.cpu_checkpointing:
-                logger.warning("cpu_checkpointing has no TPU analog yet; "
-                               "activations recompute instead of offloading")
+                if mcfg is not None and hasattr(mcfg, "remat"):
+                    # reference cpu_checkpointing: saved activations move to
+                    # host instead of recomputing — the XLA host-offload
+                    # remat policy
+                    mcfg.remat_policy = "offload_dots_to_host"
+                    log_dist("cpu_checkpointing: dot activations offload to "
+                             "pinned host memory")
+                else:
+                    logger.warning(
+                        "cpu_checkpointing configured but the model does not "
+                        "expose a remat flag; activations recompute instead "
+                        "of offloading")
 
         # ------------------------------------------------- data efficiency
         # (reference: deepspeed/runtime/data_pipeline/ — curriculum seqlen

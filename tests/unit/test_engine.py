@@ -246,3 +246,21 @@ def test_mics_conflicting_fsdp_rejected():
                         parallelism={"fsdp": 4})
     with _pytest.raises(ValueError, match="mics_shard_size"):
         dstpu.initialize(model=model, config=cfg)
+
+
+def test_cpu_checkpointing_offloads_activations():
+    """cpu_checkpointing (reference runtime/activation_checkpointing) maps to
+    the XLA host-offload remat policy and the engine must train under it."""
+    from deepspeedsyclsupport_tpu.models import build_model
+
+    model = build_model("tiny", dtype="float32")
+    engine, *_ = dstpu.initialize(model=model, config=simple_config(
+        activation_checkpointing={"partition_activations": True,
+                                  "cpu_checkpointing": True}))
+    assert model.config.remat and \
+        model.config.remat_policy == "offload_dots_to_host"
+    ids = np.random.RandomState(0).randint(
+        0, model.config.vocab_size,
+        (engine.train_batch_size(), 16)).astype(np.int32)
+    m = engine.train_batch({"input_ids": ids})
+    assert np.isfinite(float(np.asarray(m["loss"])))
