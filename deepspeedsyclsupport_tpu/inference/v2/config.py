@@ -24,13 +24,23 @@ class RaggedInferenceConfig:
     seed: int = 0
     quantize_weights: bool = False   # ZeRO-Inference int8 layer weights
     quant_group_size: int = 64
-    prefill_attn: str = "auto"       # auto | flash | xla (mixed-batch path)
+    # mixed/prefill-batch attention path: "kernel" = ragged paged-attention
+    # Pallas kernel (atoms; the blocked_flash analog), "flash" = packed flash
+    # over gathered per-sequence KV, "xla" = exact reference
+    prefill_attn: str = "auto"  # auto | kernel | kernel_interpret | flash | xla
+    atom_q_size: Optional[int] = None  # q rows per atom (default ≤128)
 
     def __post_init__(self):
-        if self.prefill_attn not in ("auto", "flash", "xla"):
+        if self.prefill_attn not in ("auto", "kernel", "kernel_interpret",
+                                     "flash", "xla"):
             raise ValueError(
-                f"prefill_attn must be auto|flash|xla, got "
-                f"{self.prefill_attn!r}")
+                f"prefill_attn must be auto|kernel|kernel_interpret|flash|"
+                f"xla, got {self.prefill_attn!r}")
+        if self.atom_q_size is None:
+            self.atom_q_size = min(128, self.max_tokens_per_batch)
+        if self.atom_q_size < 1:
+            raise ValueError(f"atom_q_size must be >= 1, got "
+                             f"{self.atom_q_size}")
         if self.num_blocks is None:
             per_seq = math.ceil(self.max_context / self.block_size)
             self.num_blocks = max(per_seq, self.max_sequences * per_seq // 2)

@@ -61,6 +61,17 @@ class InferenceEngineV2:
         self._decode_forward = None  # built lazily (kernel path)
         self._rng = jax.random.PRNGKey(cfg.seed)
         self._sample_fn = jax.jit(sample_token, static_argnums=(2,))
+        # atoms feed only the ragged paged-attention kernel path — decide
+        # ONCE whether that path can run (alibi/window models downgrade to
+        # packed flash) so prefill forwards skip the host atom build +
+        # five-array transfer when it cannot
+        mcfg = model.config
+        kernel_possible = (cfg.prefill_attn in ("kernel", "kernel_interpret")
+                           or (cfg.prefill_attn == "auto"
+                               and jax.default_backend() == "tpu"))
+        self._use_atoms = (kernel_possible
+                           and getattr(mcfg, "pos_embed", "rope") != "alibi"
+                           and getattr(mcfg, "sliding_window", None) is None)
         log_dist(f"ragged engine: {cfg.num_blocks} KV blocks × {cfg.block_size} "
                  f"tokens, budget {cfg.max_tokens_per_batch} tok/fwd, "
                  f"≤{cfg.max_sequences} seqs")
@@ -197,12 +208,22 @@ class InferenceEngineV2:
         cfg = self.config
         if all(n == 1 and d.n_cached > 0 for d, n in chunks):
             return self._run_decode(chunks)  # kernel fast path
-        batch = build_ragged_batch(chunks, cfg.max_tokens_per_batch,
-                                   cfg.max_sequences, cfg.blocks_per_seq)
+        batch = build_ragged_batch(
+            chunks, cfg.max_tokens_per_batch, cfg.max_sequences,
+            cfg.blocks_per_seq,
+            atom_q=cfg.atom_q_size if self._use_atoms else None)
+        atom_args = ()
+        if self._use_atoms:
+            atom_args = (jnp.asarray(batch.atom_qidx),
+                         jnp.asarray(batch.atom_pos0),
+                         jnp.asarray(batch.atom_qlen),
+                         jnp.asarray(batch.atom_tables),
+                         jnp.asarray(batch.atom_inv))
         logits, self.kv = self._forward(
             self.params, self.kv, jnp.asarray(batch.tokens),
             jnp.asarray(batch.token_seq), jnp.asarray(batch.token_pos),
-            jnp.asarray(batch.block_tables), jnp.asarray(batch.last_tok_idx))
+            jnp.asarray(batch.block_tables), jnp.asarray(batch.last_tok_idx),
+            *atom_args)
         return np.asarray(logits[:len(chunks)])
 
     def _run_decode(self, chunks) -> np.ndarray:

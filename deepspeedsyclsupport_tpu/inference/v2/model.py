@@ -213,7 +213,9 @@ def _packed_flash_attention(q, k_cache, v_cache, token_seq, token_pos,
 
 
 def ragged_forward(model, params: Any, kv: BlockedKV, tokens, token_seq,
-                   token_pos, block_tables, last_tok_idx, *, block_size: int,
+                   token_pos, block_tables, last_tok_idx,
+                   atom_qidx=None, atom_pos0=None, atom_qlen=None,
+                   atom_tables=None, atom_inv=None, *, block_size: int,
                    attn_impl: str = "auto"
                    ) -> Tuple[jnp.ndarray, BlockedKV]:
     """Flat-token forward. Returns (per-slot last-token logits [S, V], new kv).
@@ -251,7 +253,28 @@ def ragged_forward(model, params: Any, kv: BlockedKV, tokens, token_seq,
                                            mode="drop")
             impl = attn_impl
             if impl == "auto":
-                impl = ("flash" if jax.default_backend() == "tpu" else "xla")
+                impl = ("kernel" if jax.default_backend() == "tpu" else "xla")
+            if impl in ("kernel", "kernel_interpret") and (
+                    ab is not None or window is not None
+                    or atom_qidx is None):
+                # the atom kernel has no alibi/window path yet — packed
+                # flash carries those architectures
+                impl = "flash"
+            if impl in ("kernel", "kernel_interpret"):
+                # ragged paged-attention kernel (arXiv:2604.15464; reference
+                # blocked_flash + atom_builder): q gathers into fixed-size
+                # single-sequence atoms; KV blocks stream via block-table
+                # DMA — the [S, max_ctx] HBM gather below never happens
+                from ...ops.paged_attention import ragged_prefill_attention
+
+                q_at = q[atom_qidx]                      # [A, BQ, H, D]
+                out_at = ragged_prefill_attention(
+                    q_at, k_cache, v_cache, atom_tables, atom_pos0,
+                    atom_qlen, block_size=bs,
+                    impl=("pallas_interpret" if impl == "kernel_interpret"
+                          else "pallas"))
+                flat = out_at.reshape(-1, *out_at.shape[2:])
+                return flat[atom_inv]                    # back to packed rows
             if impl == "flash":
                 return _packed_flash_attention(q, k_cache, v_cache, token_seq,
                                                token_pos, block_tables, bs,

@@ -82,6 +82,13 @@ class RaggedBatch:
     last_tok_idx: np.ndarray  # [S] int32 index into tokens of each slot's last chunk token
     seq_active: np.ndarray    # [S] bool
     uids: List[int]           # slot -> uid (host only)
+    # atom decomposition (reference atom_builder, ragged_ops/): fixed-size
+    # single-sequence q tiles for the ragged paged-attention kernel
+    atom_qidx: Optional[np.ndarray] = None    # [A, BQ] packed-row gather idx
+    atom_pos0: Optional[np.ndarray] = None    # [A] first q position
+    atom_qlen: Optional[np.ndarray] = None    # [A] valid rows (0 = dead atom)
+    atom_tables: Optional[np.ndarray] = None  # [A, Bps] owning block-table row
+    atom_inv: Optional[np.ndarray] = None     # [T] packed row -> a*BQ + off
 
     @property
     def current_tokens(self) -> int:
@@ -90,7 +97,8 @@ class RaggedBatch:
 
 def build_ragged_batch(chunks: Sequence[Tuple[SequenceDescriptor, int]],
                        max_tokens: int, max_sequences: int,
-                       blocks_per_seq: int) -> RaggedBatch:
+                       blocks_per_seq: int,
+                       atom_q: Optional[int] = None) -> RaggedBatch:
     """Assemble metadata for scheduled ``(descriptor, n_tokens)`` chunks.
 
     The chunk's tokens are ``desc.pending[:n_tokens]``; positions continue from
@@ -122,5 +130,38 @@ def build_ragged_batch(chunks: Sequence[Tuple[SequenceDescriptor, int]],
         active[slot] = True
         uids.append(desc.uid)
         cursor += n
+
+    atoms = {}
+    if atom_q:
+        # atoms: ≤atom_q-row single-sequence q tiles (reference atom_builder).
+        # Worst case sum(ceil(n_i/BQ)) ≤ S + T//BQ; slot A_max-1 is reserved
+        # DEAD (qlen 0) so padded packed rows gather a guaranteed-zero output
+        BQ = atom_q
+        A_max = S + T // BQ + 1
+        atom_qidx = np.zeros((A_max, BQ), np.int32)
+        atom_pos0 = np.zeros((A_max,), np.int32)
+        atom_qlen = np.zeros((A_max,), np.int32)
+        atom_tables = np.zeros((A_max, blocks_per_seq), np.int32)
+        atom_inv = np.full((T,), (A_max - 1) * BQ, np.int32)
+        a = 0
+        cur = 0
+        for slot, (desc, n) in enumerate(chunks):
+            pos0 = desc.n_cached
+            k = 0
+            while k * BQ < n:
+                ql = min(BQ, n - k * BQ)
+                rows = cur + k * BQ + np.arange(ql)
+                atom_qidx[a, :ql] = rows
+                atom_pos0[a] = pos0 + k * BQ
+                atom_qlen[a] = ql
+                atom_tables[a] = block_tables[slot]
+                atom_inv[rows] = a * BQ + np.arange(ql)
+                a += 1
+                k += 1
+            cur += n
+        assert a <= A_max - 1, "atom overflow — builder bug"
+        atoms = dict(atom_qidx=atom_qidx, atom_pos0=atom_pos0,
+                     atom_qlen=atom_qlen, atom_tables=atom_tables,
+                     atom_inv=atom_inv)
     return RaggedBatch(tokens, token_seq, token_pos, block_tables, last_tok,
-                       active, uids)
+                       active, uids, **atoms)

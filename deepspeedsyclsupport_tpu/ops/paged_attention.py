@@ -220,3 +220,188 @@ def paged_decode_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
     return paged_decode_attention_reference(
         q, k_cache, v_cache, block_tables, seq_lens, block_size=block_size,
         alibi=alibi, window=window)
+
+
+# ===================================================================== prefill
+def _prefill_kernel(block_tables_ref, pos0_ref, qlen_ref,  # scalar prefetch
+                    q_ref, k_hbm, v_hbm,                   # tensors
+                    out_ref,                               # output
+                    k_vmem, v_vmem, sem,                   # scratch
+                    *, block_size: int, max_blocks: int, group: int):
+    """One program per ATOM: a ≤block_q-token slice of ONE sequence's packed
+    prefill chunk. The atom's q tile attends over the sequence's paged KV
+    (resolved through its block-table row) with per-row causality — the
+    'ragged paged attention' unification of prefill and decode (paper
+    arXiv:2604.15464; reference atom_builder + blocked_flash,
+    ``inference/v2/kernels/ragged_ops/``). KV blocks stream through the same
+    double-buffered DMA pipeline as the decode kernel, so per-sequence KV is
+    NEVER materialized in HBM (the O(S·max_ctx) gather this replaces)."""
+    a = pl.program_id(0)
+    pos0 = pos0_ref[a]
+    qlen = qlen_ref[a]
+    # kv tokens this atom may see, clamped to the block table's capacity so
+    # the prefetch below can never index past the table or start a DMA that
+    # is never awaited
+    kv_hi = jnp.minimum(pos0 + qlen, max_blocks * block_size)
+    q = q_ref[0].astype(jnp.float32)          # [BQ, H, D]
+    bq, h, d = q.shape
+    kvh = k_vmem.shape[2]
+    g = group
+    # [KVH, BQ·G, D]: kv head-major so each kv head batch-matmuls its group
+    q_g = jnp.transpose(q.reshape(bq, kvh, g, d), (1, 0, 2, 3)) \
+        .reshape(kvh, bq * g, d)
+    # q row of each [BQ·G] lane (its position is pos0 + row)
+    row = jax.lax.broadcasted_iota(jnp.int32, (kvh, bq * g, block_size),
+                                   1) // g
+
+    def copies(j, slot):
+        blk = block_tables_ref[a, j]
+        cp_k = pltpu.make_async_copy(
+            k_hbm.at[pl.ds(blk * block_size, block_size)], k_vmem.at[slot],
+            sem.at[slot, 0])
+        cp_v = pltpu.make_async_copy(
+            v_hbm.at[pl.ds(blk * block_size, block_size)], v_vmem.at[slot],
+            sem.at[slot, 1])
+        return cp_k, cp_v
+
+    @pl.when(kv_hi > 0)
+    def _():
+        cp_k, cp_v = copies(0, 0)
+        cp_k.start()
+        cp_v.start()
+
+    def body(j, carry):
+        m, l, acc = carry
+        active = j * block_size < kv_hi
+        cur = jax.lax.rem(j, 2)
+
+        @pl.when(jnp.logical_and((j + 1) * block_size < kv_hi,
+                                 j + 1 < max_blocks))
+        def _():
+            cp_k, cp_v = copies(j + 1, jax.lax.rem(j + 1, 2))
+            cp_k.start()
+            cp_v.start()
+
+        @pl.when(active)
+        def _():
+            cp_k, cp_v = copies(j, cur)
+            cp_k.wait()
+            cp_v.wait()
+
+        k = k_vmem[cur].astype(jnp.float32)    # [bs, KVH, D]
+        v = v_vmem[cur].astype(jnp.float32)
+        k_t = jnp.transpose(k, (1, 0, 2))      # [KVH, bs, D]
+        v_t = jnp.transpose(v, (1, 0, 2))
+        scores = jax.lax.dot_general(           # [KVH, BQ·G, bs]
+            q_g, k_t, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) / np.sqrt(d)
+        pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (kvh, bq * g, block_size), 2)
+        valid = jnp.logical_and(pos <= pos0 + row,   # per-row causality
+                                jnp.logical_and(row < qlen, active))
+        scores = jnp.where(valid, scores, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.where(valid, jnp.exp(scores - m_new), 0.0)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v_t, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        acc_new = acc * alpha + pv
+        return (jnp.where(active, m_new, m), jnp.where(active, l_new, l),
+                jnp.where(active, acc_new, acc))
+
+    m0 = jnp.full((kvh, bq * g, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((kvh, bq * g, 1), jnp.float32)
+    acc0 = jnp.zeros((kvh, bq * g, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, max_blocks, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)
+    out = jnp.transpose(out.reshape(kvh, bq, g, d), (1, 0, 2, 3))
+    out_ref[0] = out.reshape(bq, h, d).astype(out_ref.dtype)
+
+
+def ragged_prefill_attention_pallas(q_atoms, k_cache, v_cache, atom_tables,
+                                    atom_pos0, atom_qlen, *,
+                                    block_size: int,
+                                    interpret: bool = False):
+    """q_atoms: [A, BQ, H, D] (one sequence per atom row block);
+    k/v_cache: [num_slots, KVH, D]; atom_tables: [A, Bps] (the owning
+    sequence's block-table row per atom); atom_pos0/atom_qlen: [A].
+    Returns [A, BQ, H, D]."""
+    a, bq, h, d = q_atoms.shape
+    kvh = k_cache.shape[1]
+    max_blocks = atom_tables.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(a,),
+        in_specs=[
+            pl.BlockSpec((1, bq, h, d), lambda i, *_: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),   # K stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),   # V stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, bq, h, d), lambda i, *_: (i, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, block_size, kvh, d), k_cache.dtype),
+            pltpu.VMEM((2, block_size, kvh, d), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    kernel = functools.partial(_prefill_kernel, block_size=block_size,
+                               max_blocks=max_blocks, group=h // kvh)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((a, bq, h, d), q_atoms.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(jnp.asarray(atom_tables, jnp.int32), jnp.asarray(atom_pos0, jnp.int32),
+      jnp.asarray(atom_qlen, jnp.int32), q_atoms, k_cache, v_cache)
+
+
+def ragged_prefill_attention_reference(q_atoms, k_cache, v_cache, atom_tables,
+                                       atom_pos0, atom_qlen, *,
+                                       block_size: int):
+    """Exact jnp oracle for the prefill kernel (parity tests + off-TPU)."""
+    a, bq, h, d = q_atoms.shape
+    kvh = k_cache.shape[1]
+    bps = atom_tables.shape[1]
+    max_ctx = bps * block_size
+    j = jnp.arange(max_ctx)
+    slot = atom_tables[:, j // block_size] * block_size + j % block_size
+    k_seq = k_cache[slot].astype(jnp.float32)   # [A, C, KVH, D]
+    v_seq = v_cache[slot].astype(jnp.float32)
+    if kvh != h:
+        rep = h // kvh
+        k_seq = jnp.repeat(k_seq, rep, axis=2)
+        v_seq = jnp.repeat(v_seq, rep, axis=2)
+    logits = jnp.einsum("aqhd,achd->ahqc", q_atoms.astype(jnp.float32),
+                        k_seq) / np.sqrt(d)
+    r = jnp.arange(bq)[None, None, :, None]
+    mask = jnp.logical_and(
+        j[None, None, None, :] <= atom_pos0[:, None, None, None] + r,
+        r < atom_qlen[:, None, None, None])
+    logits = jnp.where(mask, logits, NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    p = jnp.where(mask.any(-1, keepdims=True), p, 0.0)  # dead rows → 0
+    out = jnp.einsum("ahqc,achd->aqhd", p, v_seq)
+    return out.astype(q_atoms.dtype)
+
+
+def ragged_prefill_attention(q_atoms, k_cache, v_cache, atom_tables,
+                             atom_pos0, atom_qlen, *, block_size: int,
+                             impl: str = "auto"):
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "pallas":
+        return ragged_prefill_attention_pallas(
+            q_atoms, k_cache, v_cache, atom_tables, atom_pos0, atom_qlen,
+            block_size=block_size)
+    if impl == "pallas_interpret":
+        return ragged_prefill_attention_pallas(
+            q_atoms, k_cache, v_cache, atom_tables, atom_pos0, atom_qlen,
+            block_size=block_size, interpret=True)
+    return ragged_prefill_attention_reference(
+        q_atoms, k_cache, v_cache, atom_tables, atom_pos0, atom_qlen,
+        block_size=block_size)
