@@ -62,16 +62,11 @@ class InferenceEngineV2:
         self._rng = jax.random.PRNGKey(cfg.seed)
         self._sample_fn = jax.jit(sample_token, static_argnums=(2,))
         # atoms feed only the ragged paged-attention kernel path — decide
-        # ONCE whether that path can run (alibi/window models downgrade to
-        # packed flash) so prefill forwards skip the host atom build +
-        # five-array transfer when it cannot
-        mcfg = model.config
-        kernel_possible = (cfg.prefill_attn in ("kernel", "kernel_interpret")
+        # ONCE whether that path runs so prefill forwards skip the host atom
+        # build + five-array transfer when it cannot
+        self._use_atoms = (cfg.prefill_attn in ("kernel", "kernel_interpret")
                            or (cfg.prefill_attn == "auto"
                                and jax.default_backend() == "tpu"))
-        self._use_atoms = (kernel_possible
-                           and getattr(mcfg, "pos_embed", "rope") != "alibi"
-                           and getattr(mcfg, "sliding_window", None) is None)
         log_dist(f"ragged engine: {cfg.num_blocks} KV blocks × {cfg.block_size} "
                  f"tokens, budget {cfg.max_tokens_per_batch} tok/fwd, "
                  f"≤{cfg.max_sequences} seqs")

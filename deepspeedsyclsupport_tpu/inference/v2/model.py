@@ -254,12 +254,8 @@ def ragged_forward(model, params: Any, kv: BlockedKV, tokens, token_seq,
             impl = attn_impl
             if impl == "auto":
                 impl = ("kernel" if jax.default_backend() == "tpu" else "xla")
-            if impl in ("kernel", "kernel_interpret") and (
-                    ab is not None or window is not None
-                    or atom_qidx is None):
-                # the atom kernel has no alibi/window path yet — packed
-                # flash carries those architectures
-                impl = "flash"
+            if impl in ("kernel", "kernel_interpret") and atom_qidx is None:
+                impl = "flash"  # no atom metadata shipped this forward
             if impl in ("kernel", "kernel_interpret"):
                 # ragged paged-attention kernel (arXiv:2604.15464; reference
                 # blocked_flash + atom_builder): q gathers into fixed-size
@@ -270,7 +266,7 @@ def ragged_forward(model, params: Any, kv: BlockedKV, tokens, token_seq,
                 q_at = q[atom_qidx]                      # [A, BQ, H, D]
                 out_at = ragged_prefill_attention(
                     q_at, k_cache, v_cache, atom_tables, atom_pos0,
-                    atom_qlen, block_size=bs,
+                    atom_qlen, block_size=bs, alibi=ab, window=window,
                     impl=("pallas_interpret" if impl == "kernel_interpret"
                           else "pallas"))
                 flat = out_at.reshape(-1, *out_at.shape[2:])

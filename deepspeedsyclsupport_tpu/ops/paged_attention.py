@@ -36,138 +36,26 @@ NEG_INF = -1e30
 
 
 # --------------------------------------------------------------------- kernel
-def _decode_kernel(block_tables_ref, seq_lens_ref,  # scalar prefetch
-                   q_ref, k_hbm, v_hbm, ab_ref,     # tensors
-                   out_ref,                         # output
-                   k_vmem, v_vmem, sem,             # scratch (double-buffered)
-                   *, block_size: int, max_blocks: int, use_alibi: bool,
-                   window):
-    s = pl.program_id(0)
-    seq_len = seq_lens_ref[s]
-    q = q_ref[0].astype(jnp.float32)          # [H, D]
-    h, d = q.shape
-    kvh = k_vmem.shape[2]
-    g = h // kvh
-    q_g = q.reshape(kvh, g, d)
-    q_pos = seq_len - 1  # decode: the query IS the newest cached token
-
-    def copies(j, slot):
-        blk = block_tables_ref[s, j]
-        cp_k = pltpu.make_async_copy(
-            k_hbm.at[pl.ds(blk * block_size, block_size)], k_vmem.at[slot],
-            sem.at[slot, 0])
-        cp_v = pltpu.make_async_copy(
-            v_hbm.at[pl.ds(blk * block_size, block_size)], v_vmem.at[slot],
-            sem.at[slot, 1])
-        return cp_k, cp_v
-
-    @pl.when(seq_len > 0)  # warm the pipe: block 0 → slot 0
-    def _():
-        cp_k, cp_v = copies(0, 0)
-        cp_k.start()
-        cp_v.start()
-
-    def body(j, carry):
-        m, l, acc = carry
-        active = j * block_size < seq_len
-        cur = jax.lax.rem(j, 2)
-
-        @pl.when((j + 1) * block_size < seq_len)  # start NEXT block's fetch
-        def _():
-            cp_k, cp_v = copies(j + 1, jax.lax.rem(j + 1, 2))
-            cp_k.start()
-            cp_v.start()
-
-        @pl.when(active)  # then wait only for the CURRENT block
-        def _():
-            cp_k, cp_v = copies(j, cur)
-            cp_k.wait()
-            cp_v.wait()
-
-        k = k_vmem[cur].astype(jnp.float32)    # [bs, KVH, D]
-        v = v_vmem[cur].astype(jnp.float32)
-        k_t = jnp.transpose(k, (1, 0, 2))      # [KVH, bs, D]
-        v_t = jnp.transpose(v, (1, 0, 2))
-        # [KVH, G, bs] = batched q_g · k_tᵀ
-        scores = jax.lax.dot_general(
-            q_g, k_t, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32) / np.sqrt(d)
-        pos = j * block_size + jax.lax.broadcasted_iota(
-            jnp.int32, (kvh, g, block_size), 2)
-        if use_alibi:
-            scores = scores + ab_ref[...].astype(jnp.float32) * (
-                pos - q_pos).astype(jnp.float32)
-        valid = jnp.logical_and(pos < seq_len, active)
-        if window is not None:
-            valid = jnp.logical_and(valid, q_pos - pos < window)
-        scores = jnp.where(valid, scores, NEG_INF)
-
-        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(scores - m_new)            # [KVH, G, bs]
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(              # [KVH, G, D]
-            p, v_t, (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)
-        acc_new = acc * alpha + pv
-        # inactive blocks read an unwritten buffer slot: even with p == 0,
-        # 0 · NaN = NaN, so the carry must be explicitly held
-        return (jnp.where(active, m_new, m), jnp.where(active, l_new, l),
-                jnp.where(active, acc_new, acc))
-
-    m0 = jnp.full((kvh, g, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((kvh, g, 1), jnp.float32)
-    acc0 = jnp.zeros((kvh, g, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, max_blocks, body, (m0, l0, acc0))
-    out = acc / jnp.maximum(l, 1e-30)
-    out_ref[0] = out.reshape(h, d).astype(out_ref.dtype)
-
-
 def paged_decode_attention_pallas(q, k_cache, v_cache, block_tables, seq_lens,
                                   *, block_size: int,
                                   alibi=None, window=None,
                                   interpret: bool = False):
     """q: [S, H, D]; k/v_cache: [num_slots, KVH, D]; block_tables: [S, Bps];
     seq_lens: [S] valid KV tokens per slot. ``alibi``: per-head slopes [H];
-    ``window``: sliding-window bound. Returns [S, H, D]."""
-    s, h, d = q.shape
-    kvh = k_cache.shape[1]
-    g = h // kvh
-    max_blocks = block_tables.shape[1]
-    if alibi is not None:
-        ab = jnp.asarray(alibi, jnp.float32).reshape(kvh, g, 1)
-    else:
-        ab = jnp.zeros((kvh, g, 1), jnp.float32)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(s,),
-        in_specs=[
-            pl.BlockSpec((1, h, d), lambda i, *_: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pl.ANY),   # K stays in HBM
-            pl.BlockSpec(memory_space=pl.ANY),   # V stays in HBM
-            pl.BlockSpec((kvh, g, 1), lambda i, *_: (0, 0, 0),
-                         memory_space=pltpu.VMEM),  # slopes: one tiny block
-        ],
-        out_specs=pl.BlockSpec((1, h, d), lambda i, *_: (i, 0, 0),
-                               memory_space=pltpu.VMEM),
-        scratch_shapes=[
-            pltpu.VMEM((2, block_size, kvh, d), k_cache.dtype),  # double buf
-            pltpu.VMEM((2, block_size, kvh, d), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),  # [buffer slot, k|v]
-        ],
-    )
-    kernel = functools.partial(_decode_kernel, block_size=block_size,
-                               max_blocks=max_blocks,
-                               use_alibi=alibi is not None,
-                               window=None if window is None else int(window))
-    return pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((s, h, d), q.dtype),
-        grid_spec=grid_spec,
-        interpret=interpret,
-    )(jnp.asarray(block_tables, jnp.int32), jnp.asarray(seq_lens, jnp.int32),
-      q, k_cache, v_cache, ab)
+    ``window``: sliding-window bound. Returns [S, H, D].
+
+    Decode IS the single-row case of the generalized ragged kernel below
+    (the paper's prefill/decode unification): each slot becomes a BQ=1 atom
+    whose query position is its newest cached token — one kernel family to
+    maintain, one DMA/online-softmax pipeline to tune."""
+    seq_lens = jnp.asarray(seq_lens, jnp.int32)
+    pos0 = jnp.maximum(seq_lens - 1, 0)
+    qlen = jnp.where(seq_lens > 0, 1, 0).astype(jnp.int32)
+    out = ragged_prefill_attention_pallas(
+        q[:, None], k_cache, v_cache, block_tables, pos0, qlen,
+        block_size=block_size, alibi=alibi, window=window,
+        interpret=interpret)
+    return out[:, 0]
 
 
 # ------------------------------------------------------------------ reference
@@ -224,10 +112,11 @@ def paged_decode_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
 
 # ===================================================================== prefill
 def _prefill_kernel(block_tables_ref, pos0_ref, qlen_ref,  # scalar prefetch
-                    q_ref, k_hbm, v_hbm,                   # tensors
+                    q_ref, k_hbm, v_hbm, ab_ref,           # tensors
                     out_ref,                               # output
                     k_vmem, v_vmem, sem,                   # scratch
-                    *, block_size: int, max_blocks: int, group: int):
+                    *, block_size: int, max_blocks: int, group: int,
+                    use_alibi: bool, window):
     """One program per ATOM: a ≤block_q-token slice of ONE sequence's packed
     prefill chunk. The atom's q tile attends over the sequence's paged KV
     (resolved through its block-table row) with per-row causality — the
@@ -297,8 +186,13 @@ def _prefill_kernel(block_tables_ref, pos0_ref, qlen_ref,  # scalar prefetch
             preferred_element_type=jnp.float32) / np.sqrt(d)
         pos = j * block_size + jax.lax.broadcasted_iota(
             jnp.int32, (kvh, bq * g, block_size), 2)
+        if use_alibi:
+            scores = scores + ab_ref[...].astype(jnp.float32) * (
+                pos - (pos0 + row)).astype(jnp.float32)
         valid = jnp.logical_and(pos <= pos0 + row,   # per-row causality
                                 jnp.logical_and(row < qlen, active))
+        if window is not None:
+            valid = jnp.logical_and(valid, (pos0 + row) - pos < window)
         scores = jnp.where(valid, scores, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
@@ -315,7 +209,11 @@ def _prefill_kernel(block_tables_ref, pos0_ref, qlen_ref,  # scalar prefetch
     m0 = jnp.full((kvh, bq * g, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((kvh, bq * g, 1), jnp.float32)
     acc0 = jnp.zeros((kvh, bq * g, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, max_blocks, body, (m0, l0, acc0))
+    # DYNAMIC trip count: dead atoms (kv_hi = 0) run zero iterations — with
+    # A_max sized for the worst case, most grid programs of a typical batch
+    # are dead and must not burn max_blocks MXU loops each
+    n_blk = (kv_hi + block_size - 1) // block_size
+    m, l, acc = jax.lax.fori_loop(0, n_blk, body, (m0, l0, acc0))
     out = acc / jnp.maximum(l, 1e-30)
     out = jnp.transpose(out.reshape(kvh, bq, g, d), (1, 0, 2, 3))
     out_ref[0] = out.reshape(bq, h, d).astype(out_ref.dtype)
@@ -323,15 +221,24 @@ def _prefill_kernel(block_tables_ref, pos0_ref, qlen_ref,  # scalar prefetch
 
 def ragged_prefill_attention_pallas(q_atoms, k_cache, v_cache, atom_tables,
                                     atom_pos0, atom_qlen, *,
-                                    block_size: int,
+                                    block_size: int, alibi=None, window=None,
                                     interpret: bool = False):
     """q_atoms: [A, BQ, H, D] (one sequence per atom row block);
     k/v_cache: [num_slots, KVH, D]; atom_tables: [A, Bps] (the owning
     sequence's block-table row per atom); atom_pos0/atom_qlen: [A].
+    ``alibi``: per-head slopes [H]; ``window``: sliding-window bound.
     Returns [A, BQ, H, D]."""
     a, bq, h, d = q_atoms.shape
     kvh = k_cache.shape[1]
+    g = h // kvh
     max_blocks = atom_tables.shape[1]
+    if alibi is not None:
+        # per-lane slope layout matches the kernel's [KVH, BQ·G] score rows:
+        # lane (r·G + gi) of kv head kh carries q head kh·G + gi
+        ab = jnp.tile(jnp.asarray(alibi, jnp.float32).reshape(kvh, 1, g),
+                      (1, bq, 1)).reshape(kvh, bq * g, 1)
+    else:
+        ab = jnp.zeros((kvh, bq * g, 1), jnp.float32)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(a,),
@@ -340,6 +247,8 @@ def ragged_prefill_attention_pallas(q_atoms, k_cache, v_cache, atom_tables,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pl.ANY),   # K stays in HBM
             pl.BlockSpec(memory_space=pl.ANY),   # V stays in HBM
+            pl.BlockSpec((kvh, bq * g, 1), lambda i, *_: (0, 0, 0),
+                         memory_space=pltpu.VMEM),  # slopes per lane
         ],
         out_specs=pl.BlockSpec((1, bq, h, d), lambda i, *_: (i, 0, 0, 0),
                                memory_space=pltpu.VMEM),
@@ -350,19 +259,22 @@ def ragged_prefill_attention_pallas(q_atoms, k_cache, v_cache, atom_tables,
         ],
     )
     kernel = functools.partial(_prefill_kernel, block_size=block_size,
-                               max_blocks=max_blocks, group=h // kvh)
+                               max_blocks=max_blocks, group=g,
+                               use_alibi=alibi is not None,
+                               window=None if window is None else int(window))
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((a, bq, h, d), q_atoms.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
     )(jnp.asarray(atom_tables, jnp.int32), jnp.asarray(atom_pos0, jnp.int32),
-      jnp.asarray(atom_qlen, jnp.int32), q_atoms, k_cache, v_cache)
+      jnp.asarray(atom_qlen, jnp.int32), q_atoms, k_cache, v_cache, ab)
 
 
 def ragged_prefill_attention_reference(q_atoms, k_cache, v_cache, atom_tables,
                                        atom_pos0, atom_qlen, *,
-                                       block_size: int):
+                                       block_size: int, alibi=None,
+                                       window=None):
     """Exact jnp oracle for the prefill kernel (parity tests + off-TPU)."""
     a, bq, h, d = q_atoms.shape
     kvh = k_cache.shape[1]
@@ -379,9 +291,16 @@ def ragged_prefill_attention_reference(q_atoms, k_cache, v_cache, atom_tables,
     logits = jnp.einsum("aqhd,achd->ahqc", q_atoms.astype(jnp.float32),
                         k_seq) / np.sqrt(d)
     r = jnp.arange(bq)[None, None, :, None]
+    q_pos = atom_pos0[:, None, None, None] + r
+    if alibi is not None:
+        logits = logits + jnp.asarray(alibi, jnp.float32)[None, :, None,
+                                                          None] * (
+            j[None, None, None, :] - q_pos).astype(jnp.float32)
     mask = jnp.logical_and(
-        j[None, None, None, :] <= atom_pos0[:, None, None, None] + r,
+        j[None, None, None, :] <= q_pos,
         r < atom_qlen[:, None, None, None])
+    if window is not None:
+        mask = jnp.logical_and(mask, q_pos - j[None, None, None, :] < window)
     logits = jnp.where(mask, logits, NEG_INF)
     p = jax.nn.softmax(logits, axis=-1)
     p = jnp.where(mask.any(-1, keepdims=True), p, 0.0)  # dead rows → 0
@@ -391,17 +310,18 @@ def ragged_prefill_attention_reference(q_atoms, k_cache, v_cache, atom_tables,
 
 def ragged_prefill_attention(q_atoms, k_cache, v_cache, atom_tables,
                              atom_pos0, atom_qlen, *, block_size: int,
-                             impl: str = "auto"):
+                             impl: str = "auto", alibi=None, window=None):
     if impl == "auto":
         impl = "pallas" if jax.default_backend() == "tpu" else "xla"
     if impl == "pallas":
         return ragged_prefill_attention_pallas(
             q_atoms, k_cache, v_cache, atom_tables, atom_pos0, atom_qlen,
-            block_size=block_size)
+            block_size=block_size, alibi=alibi, window=window)
     if impl == "pallas_interpret":
         return ragged_prefill_attention_pallas(
             q_atoms, k_cache, v_cache, atom_tables, atom_pos0, atom_qlen,
-            block_size=block_size, interpret=True)
+            block_size=block_size, alibi=alibi, window=window,
+            interpret=True)
     return ragged_prefill_attention_reference(
         q_atoms, k_cache, v_cache, atom_tables, atom_pos0, atom_qlen,
-        block_size=block_size)
+        block_size=block_size, alibi=alibi, window=window)
