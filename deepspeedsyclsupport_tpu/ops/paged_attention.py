@@ -62,32 +62,14 @@ def paged_decode_attention_pallas(q, k_cache, v_cache, block_tables, seq_lens,
 def paged_decode_attention_reference(q, k_cache, v_cache, block_tables,
                                      seq_lens, *, block_size: int,
                                      alibi=None, window=None):
-    """Exact jnp implementation (parity target + off-TPU fallback)."""
-    s, h, d = q.shape
-    kvh = k_cache.shape[1]
-    bps = block_tables.shape[1]
-    max_ctx = bps * block_size
-    j = jnp.arange(max_ctx)
-    slot = block_tables[:, j // block_size] * block_size + j % block_size
-    k_seq = k_cache[slot].astype(jnp.float32)   # [S, C, KVH, D]
-    v_seq = v_cache[slot].astype(jnp.float32)
-    if kvh != h:
-        rep = h // kvh
-        k_seq = jnp.repeat(k_seq, rep, axis=2)
-        v_seq = jnp.repeat(v_seq, rep, axis=2)
-    logits = jnp.einsum("shd,schd->shc", q.astype(jnp.float32),
-                        k_seq) / np.sqrt(d)
-    q_pos = (seq_lens - 1)[:, None, None]      # the newest cached token
-    if alibi is not None:
-        logits = logits + jnp.asarray(alibi, jnp.float32)[None, :, None] * (
-            j[None, None, :] - q_pos).astype(jnp.float32)
-    mask = (j[None, :] < seq_lens[:, None])[:, None, :]
-    if window is not None:
-        mask = jnp.logical_and(mask, q_pos - j[None, None, :] < window)
-    logits = jnp.where(mask, logits, NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("shc,schd->shd", probs, v_seq)
-    return out.astype(q.dtype)
+    """Exact jnp oracle — decode as the BQ=1 case of the ragged reference
+    (one oracle to maintain, mirroring the Pallas unification)."""
+    seq_lens = jnp.asarray(seq_lens, jnp.int32)
+    out = ragged_prefill_attention_reference(
+        q[:, None], k_cache, v_cache, block_tables,
+        jnp.maximum(seq_lens - 1, 0), (seq_lens > 0).astype(jnp.int32),
+        block_size=block_size, alibi=alibi, window=window)
+    return out[:, 0]
 
 
 def paged_decode_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
@@ -132,6 +114,12 @@ def _prefill_kernel(block_tables_ref, pos0_ref, qlen_ref,  # scalar prefetch
     # the prefetch below can never index past the table or start a DMA that
     # is never awaited
     kv_hi = jnp.minimum(pos0 + qlen, max_blocks * block_size)
+    # sliding window: blocks entirely below row 0's window are masked for
+    # EVERY row — skip their DMA and matmuls instead of NEG_INF-ing them
+    if window is not None:
+        lo_blk = jnp.maximum(pos0 + 1 - window, 0) // block_size
+    else:
+        lo_blk = jnp.int32(0)
     q = q_ref[0].astype(jnp.float32)          # [BQ, H, D]
     bq, h, d = q.shape
     kvh = k_vmem.shape[2]
@@ -155,7 +143,7 @@ def _prefill_kernel(block_tables_ref, pos0_ref, qlen_ref,  # scalar prefetch
 
     @pl.when(kv_hi > 0)
     def _():
-        cp_k, cp_v = copies(0, 0)
+        cp_k, cp_v = copies(lo_blk, jax.lax.rem(lo_blk, 2))
         cp_k.start()
         cp_v.start()
 
@@ -213,7 +201,7 @@ def _prefill_kernel(block_tables_ref, pos0_ref, qlen_ref,  # scalar prefetch
     # A_max sized for the worst case, most grid programs of a typical batch
     # are dead and must not burn max_blocks MXU loops each
     n_blk = (kv_hi + block_size - 1) // block_size
-    m, l, acc = jax.lax.fori_loop(0, n_blk, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(lo_blk, n_blk, body, (m0, l0, acc0))
     out = acc / jnp.maximum(l, 1e-30)
     out = jnp.transpose(out.reshape(kvh, bq, g, d), (1, 0, 2, 3))
     out_ref[0] = out.reshape(bq, h, d).astype(out_ref.dtype)

@@ -219,3 +219,24 @@ def test_engine_kernel_path_alibi_and_window():
             logits = model.apply(params, jnp.asarray([seq], jnp.int32))
             seq.append(int(jnp.argmax(logits[0, -1])))
         assert got[0] == seq[6:]
+
+
+def test_decode_dead_slot_exact_zero():
+    """seq_len == 0 slots must produce exact zeros from BOTH the unified
+    kernel and the jnp oracle (regression: the oracle used to emit
+    uniform-softmax garbage for dead slots)."""
+    from deepspeedsyclsupport_tpu.ops.paged_attention import (
+        paged_decode_attention, paged_decode_attention_reference)
+
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(4, 4, 32), jnp.float32)
+    kc = jnp.asarray(rng.randn(64, 2, 32), jnp.float32)
+    vc = jnp.asarray(rng.randn(64, 2, 32), jnp.float32)
+    bt = jnp.asarray(rng.randint(0, 8, (4, 4)), jnp.int32)
+    sl = jnp.asarray([17, 1, 0, 30], jnp.int32)
+    ref = paged_decode_attention_reference(q, kc, vc, bt, sl, block_size=8)
+    got = paged_decode_attention(q, kc, vc, bt, sl, block_size=8,
+                                 impl="pallas_interpret")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    assert float(jnp.abs(got[2]).max()) == 0.0
