@@ -78,6 +78,47 @@ def dequantize_int8(q: jnp.ndarray, scale: jnp.ndarray, group_size: int = -1,
     return (q.astype(jnp.float32) * scale).astype(dtype)
 
 
+def quantize_int4(x: jnp.ndarray, group_size: int = -1):
+    """Symmetric int4 packed two-per-byte (reference
+    ``csrc/quantization/quantize_intX.cu``): values in [-7, 7], biased to
+    nibbles, low nibble = even element. Last dim must be even. Returns
+    (packed uint8 [..., n/2], scales fp32)."""
+    n = x.shape[-1]
+    if n % 2:
+        raise ValueError(f"int4 packing needs an even last dim, got {n}")
+    if group_size and group_size > 0:
+        shape = x.shape
+        assert shape[-1] % group_size == 0, (shape, group_size)
+        xg = x.reshape(*shape[:-1], shape[-1] // group_size, group_size)
+        amax = jnp.max(jnp.abs(xg), axis=-1, keepdims=True) + 1e-12
+        scale = (amax / 7.0).astype(jnp.float32)
+        q = jnp.clip(jnp.round(xg / scale), -7, 7).astype(jnp.int32)
+        q = q.reshape(shape)
+        scale = scale.squeeze(-1)
+    else:
+        amax = jnp.max(jnp.abs(x)) + 1e-12
+        scale = (amax / 7.0).astype(jnp.float32)
+        q = jnp.clip(jnp.round(x / scale), -7, 7).astype(jnp.int32)
+    nib = (q + 8).astype(jnp.uint8)             # 1..15
+    lo, hi = nib[..., 0::2], nib[..., 1::2]
+    return (lo | (hi << 4)).astype(jnp.uint8), scale
+
+
+def dequantize_int4(packed: jnp.ndarray, scale: jnp.ndarray,
+                    group_size: int = -1, dtype=jnp.float32) -> jnp.ndarray:
+    b = packed.astype(jnp.int32)
+    lo = (b & 0xF) - 8
+    hi = ((b >> 4) & 0xF) - 8
+    q = jnp.stack([lo, hi], axis=-1).reshape(*packed.shape[:-1],
+                                             packed.shape[-1] * 2)
+    if group_size and group_size > 0:
+        shape = q.shape
+        qg = q.reshape(*shape[:-1], shape[-1] // group_size, group_size)
+        out = qg.astype(jnp.float32) * scale[..., None]
+        return out.reshape(shape).astype(dtype)
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
 @jax.tree_util.register_pytree_node_class
 class QuantTensor:
     """int8 weight + blockwise fp32 scales, as ONE pytree node.
@@ -91,38 +132,48 @@ class QuantTensor:
     most one layer's weights exist dequantized at a time.
     """
 
-    def __init__(self, q, scale, group_size: int):
+    def __init__(self, q, scale, group_size: int, bits: int = 8):
         self.q = q
         self.scale = scale
         self.group_size = int(group_size)
+        self.bits = int(bits)
 
     @property
     def shape(self):
+        if self.bits == 4:  # packed two-per-byte on the last dim
+            return self.q.shape[:-1] + (self.q.shape[-1] * 2,)
         return self.q.shape
 
     def dequantize(self, dtype=jnp.bfloat16):
+        if self.bits == 4:
+            return dequantize_int4(self.q, self.scale,
+                                   group_size=self.group_size, dtype=dtype)
         return dequantize_int8(self.q, self.scale,
                                group_size=self.group_size, dtype=dtype)
 
     def tree_flatten(self):
-        return (self.q, self.scale), self.group_size
+        return (self.q, self.scale), (self.group_size, self.bits)
 
     @classmethod
-    def tree_unflatten(cls, group_size, children):
-        return cls(children[0], children[1], group_size)
+    def tree_unflatten(cls, aux, children):
+        group_size, bits = aux if isinstance(aux, tuple) else (aux, 8)
+        return cls(children[0], children[1], group_size, bits)
 
     def __repr__(self):
         return (f"QuantTensor(q={self.q.shape}, scale={self.scale.shape}, "
                 f"group={self.group_size})")
 
 
-def quantize_leaf(x, group_size: int = 64) -> "QuantTensor":
+def quantize_leaf(x, group_size: int = 64, bits: int = 8) -> "QuantTensor":
     """Blockwise int8 quantization of one weight (last-dim groups; one scale
     per row when the last dim doesn't divide — the scale must keep the
     leading dims so stacked [L, ...] leaves stay scan-sliceable)."""
     x = jnp.asarray(x)
     gs = group_size if (group_size > 0 and x.ndim
                         and x.shape[-1] % group_size == 0) else x.shape[-1]
+    if bits == 4 and x.shape[-1] % 2 == 0 and gs % 2 == 0:
+        q, scale = quantize_int4(x.astype(jnp.float32), group_size=gs)
+        return QuantTensor(q, scale, gs, bits=4)
     q, scale = quantize_int8(x.astype(jnp.float32), group_size=gs)
     return QuantTensor(q, scale, gs)
 
@@ -135,7 +186,7 @@ def dequantize_tree(tree, dtype=jnp.bfloat16):
 
 
 def quantize_tree(tree, group_size: int = 64, min_size: int = 4096,
-                  stacked: bool = False):
+                  stacked: bool = False, bits: int = 8):
     """Quantize matrix-shaped floating leaves with ``>= min_size`` elements.
 
     Small or 1-D leaves — norm scales, biases — stay full precision, like
@@ -153,7 +204,7 @@ def quantize_tree(tree, group_size: int = 64, min_size: int = 4096,
         body = shape[1:] if (stacked and len(shape) > 1) else shape
         if (hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
                 and len(body) >= 2 and _np.prod(body) >= min_size):
-            return quantize_leaf(x, group_size)
+            return quantize_leaf(x, group_size, bits=bits)
         return x
 
     return jax.tree_util.tree_map(maybe, tree)

@@ -45,6 +45,7 @@ class ZeroInferenceQuantConfig:
     enabled: bool = False
     group_size: int = 64    # elements per scale block
     min_size: int = 4096    # leaves smaller than this stay full precision
+    bits: int = 8           # 8 (int8) or 4 (packed int4, quantize_intX analog)
 
     @classmethod
     def from_value(cls, v) -> "ZeroInferenceQuantConfig":
@@ -53,9 +54,13 @@ class ZeroInferenceQuantConfig:
         if isinstance(v, bool):
             return cls(enabled=v)
         d = dict(v or {})
+        bits = int(d.get("bits", 8))
+        if bits not in (4, 8):
+            raise ValueError(f"quant.bits must be 4 or 8, got {bits}")
         return cls(enabled=bool(d.get("enabled", False)),
                    group_size=int(d.get("group_size", 64)),
-                   min_size=int(d.get("min_size", 4096)))
+                   min_size=int(d.get("min_size", 4096)),
+                   bits=bits)
 
 
 @dataclass

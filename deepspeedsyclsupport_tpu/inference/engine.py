@@ -147,7 +147,8 @@ class InferenceEngine:
         # fp + int8; for models near the HBM limit quantize before placing.
         self.params["layers"] = jax.jit(
             lambda t: quantize_tree(t, qcfg.group_size, qcfg.min_size,
-                                    stacked=stacked))(self.params["layers"])
+                                    stacked=stacked,
+                                    bits=qcfg.bits))(self.params["layers"])
         after = nbytes(self.params["layers"])
         # shardings must mirror the (changed) params tree; tp==1 here, so
         # everything is replicated

@@ -22,8 +22,9 @@ class RaggedInferenceConfig:
     # memory_config-driven cache sizing)
     dtype: Any = jnp.bfloat16
     seed: int = 0
-    quantize_weights: bool = False   # ZeRO-Inference int8 layer weights
+    quantize_weights: bool = False   # ZeRO-Inference int8/int4 layer weights
     quant_group_size: int = 64
+    quant_bits: int = 8              # 8 or 4 (packed)
     # mixed/prefill-batch attention path: "kernel" = ragged paged-attention
     # Pallas kernel (atoms; the blocked_flash analog), "flash" = packed flash
     # over gathered per-sequence KV, "xla" = exact reference
@@ -41,6 +42,9 @@ class RaggedInferenceConfig:
         if self.atom_q_size < 1:
             raise ValueError(f"atom_q_size must be >= 1, got "
                              f"{self.atom_q_size}")
+        if self.quant_bits not in (4, 8):
+            raise ValueError(f"quant_bits must be 4 or 8, got "
+                             f"{self.quant_bits}")
         if self.num_blocks is None:
             per_seq = math.ceil(self.max_context / self.block_size)
             self.num_blocks = max(per_seq, self.max_sequences * per_seq // 2)

@@ -50,7 +50,8 @@ class InferenceEngineV2:
             # InferenceEngine._quantize_weights)
             self.params["layers"] = jax.jit(
                 lambda t: quantize_tree(t, cfg.quant_group_size,
-                                        stacked=stacked))(
+                                        stacked=stacked,
+                                        bits=cfg.quant_bits))(
                 self.params["layers"])
 
         self.kv = init_blocked_kv(model.config, cfg)
