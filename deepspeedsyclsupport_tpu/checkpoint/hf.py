@@ -44,6 +44,8 @@ SAFE_INDEX = "model.safetensors.index.json"
 SAFE_SINGLE = "model.safetensors"
 BIN_INDEX = "pytorch_model.bin.index.json"
 BIN_SINGLE = "pytorch_model.bin"
+# top-level module prefixes that HF exports variously carry or drop
+_MODULE_PREFIXES = ("transformer.", "model.", "gpt_neox.")
 
 
 # --------------------------------------------------------------------- config
@@ -253,6 +255,16 @@ class HFCheckpointSource:
             raise FileNotFoundError(
                 f"no model.safetensors[.index.json] or pytorch_model.bin"
                 f"[.index.json] under {path}")
+        # Detect ONCE whether this checkpoint's names carry a top-level
+        # module prefix, so resolve() maps in a single direction. Trying
+        # both directions per tensor could silently load a different tensor
+        # when a checkpoint contains both a prefixed and an unprefixed
+        # tensor of the same suffix, masking a family-map bug.
+        self._ckpt_prefix: Optional[str] = None
+        for pre in _MODULE_PREFIXES:
+            if any(n.startswith(pre) for n in self._name_to_file):
+                self._ckpt_prefix = pre
+                break
 
     @property
     def names(self) -> Iterable[str]:
@@ -263,14 +275,22 @@ class HFCheckpointSource:
 
     def resolve(self, name: str) -> Optional[str]:
         """Checkpoint name variants: some exports carry/drop the top-level
-        module prefix (``transformer.``/``model.``/``gpt_neox.``)."""
+        module prefix (``transformer.``/``model.``/``gpt_neox.``). The
+        direction is fixed per checkpoint (detected at index time): a
+        prefixed checkpoint only ever gains the prefix on unprefixed
+        lookups; an unprefixed one only ever strips it — never both, so a
+        wrong family map fails loudly instead of quietly mis-loading."""
         if name in self._name_to_file:
             return name
-        for pre in ("transformer.", "model.", "gpt_neox."):
+        if self._ckpt_prefix is not None:
+            if not name.startswith(self._ckpt_prefix):
+                cand = self._ckpt_prefix + name
+                if cand in self._name_to_file:
+                    return cand
+            return None
+        for pre in _MODULE_PREFIXES:
             if name.startswith(pre) and name[len(pre):] in self._name_to_file:
                 return name[len(pre):]
-            if pre + name in self._name_to_file:
-                return pre + name
         return None
 
     def _load_bin(self, fname: str) -> Dict[str, Any]:

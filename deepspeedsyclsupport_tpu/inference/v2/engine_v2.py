@@ -10,7 +10,8 @@ host scheduler picks chunks → ``RaggedBatch`` metadata built and shipped →
 ONE jitted ragged forward (QKV+RoPE+paged-append, blocked attention, MLP,
 logits gather) → last-token logits land back in each sequence descriptor.
 """
-from typing import Dict, List, Optional, Sequence
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -25,6 +26,30 @@ from ..params import place_inference_params
 from ..sampling import SamplingParams, sample_token
 from ...comm.topology import MeshTopology, build_topology
 from ...utils.logging import log_dist
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionResult:
+    """Structured admission decision (reference ``can_schedule:179`` returns
+    schedulability for the serving layer to back off on — this names WHO was
+    rejected and WHY instead of a bare bool)."""
+    admitted: Tuple[int, ...]
+    reasons: Dict[int, str]  # per rejected uid
+
+    @property
+    def rejected(self) -> Tuple[int, ...]:
+        return tuple(self.reasons)
+
+    def __bool__(self) -> bool:
+        return not self.reasons
+
+
+class PutResult(Dict[int, np.ndarray]):
+    """:meth:`InferenceEngineV2.put`'s return: the {uid: last-token logits}
+    mapping (drop-in for the plain dict earlier rounds returned) plus the
+    admission outcome, so schedulers see partial rejection without an
+    exception tearing down the whole batch."""
+    admission: AdmissionResult
 
 
 class InferenceEngineV2:
@@ -145,42 +170,76 @@ class InferenceEngineV2:
                      lengths: Sequence[int]) -> bool:
         """Admission check (reference ``can_schedule:179``): sequence slots,
         per-seq context limit, and worst-case KV block pressure."""
+        return not self.check_schedule(uids, lengths).rejected
+
+    def check_schedule(self, uids: Sequence[int],
+                       lengths: Sequence[int]) -> "AdmissionResult":
+        """Per-uid admission (the structured form of ``can_schedule``):
+        greedily admits uids in caller order while sequence slots, per-seq
+        context, and worst-case KV block pressure allow, and names the limit
+        that rejected each of the rest — so an external scheduler can back
+        off per sequence instead of all-or-nothing."""
         cfg = self.config
-        new = [u for u in uids if u not in self.seqs]
-        if len(self.seqs) + len(new) > cfg.max_sequences:
-            return False
-        want_blocks = 0
+        slots = len(self.seqs)
+        free = self.allocator.free_blocks
+        admitted: List[int] = []
+        rejected: Dict[int, str] = {}
         for u, n in zip(uids, lengths):
             d = self.seqs.get(u)
             # undrained pending tokens count toward context/KV demand too
             cached = (d.n_cached + len(d.pending)) if d else 0
             have = len(d.blocks) if d else 0
             if cached + n > cfg.max_context:
-                return False
-            want_blocks += max(0, -(-(cached + n) // cfg.block_size) - have)
-        return want_blocks <= self.allocator.free_blocks
+                rejected[u] = (f"context: {cached}+{n} tokens exceeds "
+                               f"max_context {cfg.max_context}")
+                continue
+            if d is None and slots + 1 > cfg.max_sequences:
+                rejected[u] = f"slots: engine at max_sequences {cfg.max_sequences}"
+                continue
+            want = max(0, -(-(cached + n) // cfg.block_size) - have)
+            if want > free:
+                rejected[u] = (f"kv: needs {want} blocks, "
+                               f"{free} free in the pool")
+                continue
+            free -= want
+            if d is None:
+                slots += 1
+            admitted.append(u)
+        return AdmissionResult(tuple(admitted), dict(rejected))
 
     # -------------------------------------------------------------------- put
     def put(self, uids: Sequence[int],
-            tokens_list: Sequence[Sequence[int]]) -> Dict[int, np.ndarray]:
-        """Enqueue tokens and run ONE ragged forward over what fits.
+            tokens_list: Sequence[Sequence[int]],
+            strict: bool = False, drain: bool = True) -> "PutResult":
+        """Enqueue tokens and run ragged forwards over what fits.
 
-        Returns {uid: last-token logits [V]} for sequences whose pending input
-        fully drained this pass (reference returns logits the same way; partial
-        prompt chunks stay pending for the next put)."""
+        Returns a :class:`PutResult`: {uid: last-token logits [V]} for
+        sequences whose pending input fully drained this pass (reference
+        returns logits the same way; partial prompt chunks stay pending for
+        the next put), carrying ``.admission`` with any rejected uids and
+        per-uid reasons. Over-budget uids are rejected structurally, not by
+        exception — raise only under ``strict=True``. ``drain=False`` runs
+        at most ONE scheduler pass + forward (the granularity an external
+        serving loop — or a TTFT benchmark — drives the engine at); the
+        default drains every pending token before returning."""
         cfg = self.config
-        if not self.can_schedule(uids, [len(t) for t in tokens_list]):
+        admission = self.check_schedule(uids, [len(t) for t in tokens_list])
+        if strict and admission.rejected:
             raise RuntimeError(
-                "cannot schedule batch: over sequence/context/KV limits "
-                "(check can_schedule first, as MII's scheduler does)")
+                f"cannot schedule batch: {dict(admission.reasons)} "
+                f"(strict=True; default is structured rejection)")
+        admitted_set = set(admission.admitted)
         for uid, toks in zip(uids, tokens_list):
+            if uid not in admitted_set:
+                continue
             d = self.seqs.get(uid)
             if d is None:
                 d = self.seqs[uid] = SequenceDescriptor(uid=uid)
             d.pending.extend(int(t) for t in toks)
             d.last_logits = None
 
-        out: Dict[int, np.ndarray] = {}
+        out = PutResult()
+        out.admission = admission
         while True:
             chunks = schedule_chunks(
                 list(self.seqs.values()), self.allocator,
@@ -196,6 +255,8 @@ class InferenceEngineV2:
                 if not d.pending:
                     d.last_logits = logits[slot]
                     out[d.uid] = d.last_logits
+            if not drain:
+                break
             if all(not d.pending for d in self.seqs.values()):
                 break
         return out

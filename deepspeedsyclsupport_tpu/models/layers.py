@@ -249,6 +249,16 @@ def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """Attention dispatch — the seam where Pallas/SP implementations plug in
     (reference analog: the op-binding indirection of
     ``ops/transformer/inference/op_binding/``)."""
+    if (window is not None and not causal
+            and kv_positions_below is None and kv_positions is None):
+        # the window bound is one-sided (how far BACK a query sees) on every
+        # backend; with no other causality mechanism in play (cached decode
+        # supplies kv_positions_below/kv_positions instead of the flag),
+        # rejecting here keeps flash and xla behavior identical instead of
+        # raising on one platform and silently attending to unbounded
+        # future keys on the other
+        raise ValueError("window requires causal=True (the sliding window "
+                         "only bounds attention to the past)")
     if impl == "auto":
         impl = "flash" if jax.default_backend() == "tpu" else "xla"
     if (kv_positions_below is not None or kv_mask is not None

@@ -276,7 +276,12 @@ class CausalLM:
             # Progressive Layer Dropping (reference
             # runtime/progressive_layer_drop.py, arXiv:2010.13369): per-layer
             # keep prob p_l = 1 − (l+1)/L·(1−θ(t)); dropped layers skip via
-            # lax.cond so they cost neither FLOPs nor activation memory
+            # lax.cond so they cost neither FLOPs nor activation memory.
+            # Recorded decision: kept layers are NOT rescaled by 1/p_l
+            # (stochastic-depth style), matching the paper and the
+            # reference, which argue PreLN identity paths tolerate the
+            # train(θ<1)/eval(all-layers) expectation gap; rescaling would
+            # also change parity with reference-trained checkpoints.
             use_pld = (pld_theta is not None and train and cache is None)
 
             def body(x, inp):

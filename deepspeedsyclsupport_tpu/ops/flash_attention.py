@@ -720,6 +720,13 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if window is not None and not causal:
+        # the window bound is one-sided (pos_q - pos_k < window): it limits
+        # how far back a query sees but places no bound on future keys, so
+        # with causal=False it would silently permit unbounded attention to
+        # the future — reject rather than guess the caller's intent
+        raise ValueError("window requires causal=True (the sliding window "
+                         "only bounds attention to the past)")
     b, sq, h, d = q.shape
     skv, kvh = k.shape[1], k.shape[2]
     if h % kvh:

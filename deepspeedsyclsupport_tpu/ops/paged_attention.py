@@ -141,7 +141,11 @@ def _prefill_kernel(block_tables_ref, pos0_ref, qlen_ref,  # scalar prefetch
             sem.at[slot, 1])
         return cp_k, cp_v
 
-    @pl.when(kv_hi > 0)
+    # guard on lo_blk (not just kv_hi > 0): with a sliding window and pos0
+    # beyond the table's capacity, lo_blk can reach max_blocks — the loop
+    # below would run zero iterations, so an unguarded warm-up would index
+    # the table out of bounds and start a DMA that is never awaited
+    @pl.when(lo_blk * block_size < kv_hi)
     def _():
         cp_k, cp_v = copies(lo_blk, jax.lax.rem(lo_blk, 2))
         cp_k.start()

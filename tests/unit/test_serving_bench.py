@@ -1,0 +1,47 @@
+"""Serving-benchmark harness smoke: the FastGen-style TTFT/throughput driver
+in ``bench.py`` (closed-loop clients, SplitFuse-vs-naive A-B) must run end to
+end on the CPU sim and produce sane, internally-consistent metrics — so the
+one real-TPU bench window can't be lost to a harness bug.
+
+Reference methodology: ``blogs/deepspeed-fastgen/README.md:139,155`` (p50
+TTFT / effective throughput vs a non-fused scheduler).
+"""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from bench import _serve_once  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def serve_result():
+    return _serve_once("tiny", "cpu", n_clients=3, reqs_per_client=2,
+                       prompt_len=24, gen_len=6, budget=32, block_size=8,
+                       max_context=64)
+
+
+class TestServingBench:
+    def test_metrics_shape(self, serve_result):
+        r = serve_result
+        assert r["metric"] == "serve_decode_tok_per_sec_per_chip_tiny"
+        assert r["unit"] == "tokens/s"
+        assert r["value"] > 0 and r["vs_baseline"] > 0
+
+    def test_all_tokens_accounted(self, serve_result):
+        """Every request generates exactly gen_len tokens (no evictions on
+        the fully-committed pool, no fabricated tokens from stale logits)."""
+        for mode in ("naive", "splitfuse"):
+            m = serve_result["detail"][mode]
+            assert m["requests"] == 6
+            assert m["evicted"] == 0
+            assert m["tokens_generated"] == 6 * 6
+            assert m["throughput_tok_s"] == pytest.approx(
+                m["tokens_generated"] / m["wall_s"], rel=0.05)
+
+    def test_latency_percentiles_sane(self, serve_result):
+        for mode in ("naive", "splitfuse"):
+            m = serve_result["detail"][mode]
+            assert 0 < m["ttft_p50_s"] <= m["ttft_p95_s"] < m["wall_s"]
