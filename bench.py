@@ -635,7 +635,22 @@ def run_serve():
 # ======================================================================
 # parent orchestration
 # ======================================================================
+def _parse_lines(text):
+    results = []
+    for line in (text or "").strip().splitlines():
+        try:
+            parsed = json.loads(line)
+            if isinstance(parsed, dict) and "metric" in parsed:
+                results.append(parsed)
+        except json.JSONDecodeError:
+            continue
+    return results
+
+
 def _spawn(rung, timeout, env_overrides):
+    """Run one rung child. Returns (results, err) — BOTH can be non-empty: a
+    child that banked some JSON lines and then died/hung keeps its partial
+    results AND reports the failure."""
     env = dict(os.environ)
     env[RUNG_ENV] = rung
     env.update(env_overrides)
@@ -643,20 +658,19 @@ def _spawn(rung, timeout, env_overrides):
         proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
                               capture_output=True, text=True, timeout=timeout,
                               env=env)
-    except subprocess.TimeoutExpired:
-        return [], f"{rung}: timeout after {timeout}s"
-    results = []
-    for line in (proc.stdout or "").strip().splitlines():
-        try:
-            parsed = json.loads(line)
-            if isinstance(parsed, dict) and "metric" in parsed:
-                results.append(parsed)
-        except json.JSONDecodeError:
-            continue
-    if results:
-        return results, None
-    tail = ((proc.stderr or "") + (proc.stdout or ""))[-1500:]
-    return [], f"{rung}: rc={proc.returncode}: {tail}"
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout
+        if isinstance(out, bytes):
+            out = out.decode("utf-8", "replace")
+        return _parse_lines(out), f"{rung}: timeout after {timeout}s"
+    results = _parse_lines(proc.stdout)
+    if proc.returncode != 0:
+        tail = ((proc.stderr or "") + (proc.stdout or ""))[-1500:]
+        return results, f"{rung}: rc={proc.returncode}: {tail}"
+    if not results:
+        tail = ((proc.stderr or "") + (proc.stdout or ""))[-1500:]
+        return results, f"{rung}: no metric emitted: {tail}"
+    return results, None
 
 
 CPU_ENV = {"JAX_PLATFORMS": "cpu", "DSTPU_ACCELERATOR": "cpu"}
@@ -671,7 +685,6 @@ def main():
     platform = probe[0]["detail"]["platform"] if probe else "cpu"
     if err:
         errors.append(err)
-    cpu_env = {} if platform == "cpu" else CPU_ENV
 
     # (rung, timeout, env, retry-on-cpu-if-tpu-attempt-fails)
     if platform == "tpu":
@@ -679,10 +692,10 @@ def main():
                 ("train", 1500, {}, True),
                 ("serve", 900, {}, True)]
     else:
-        plan = [("serve", 500, cpu_env, False),
-                ("train", 700, cpu_env, False)]
+        plan = [("serve", 500, CPU_ENV, False),
+                ("train", 700, CPU_ENV, False)]
 
-    degraded = platform != "tpu"
+    degraded = False
     for rung, timeout, env, cpu_retry in plan:
         remaining = deadline - time.monotonic()
         if remaining < 60:

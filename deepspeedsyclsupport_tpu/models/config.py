@@ -59,6 +59,14 @@ class ModelConfig:
     remat: bool = False             # jax.checkpoint each layer (activation ckpt)
     remat_policy: Optional[str] = None  # jax.checkpoint_policies name
     scan_layers: bool = True        # lax.scan over stacked layer params
+    # pipeline microbatches per forward when the topology has pipe>1
+    # (None => number of stages); config key pipeline.micro_batches
+    pipe_microbatches: Optional[int] = None
+    # pipe-stage count the trunk is built for. The engine sets this from its
+    # topology at init so the pipelined trunk is an EXPLICIT config property
+    # (visible to jit retracing), not a hidden global read; None falls back
+    # to the world topology's pipe axis for direct model use.
+    pipe_stages: Optional[int] = None
     dropout: float = 0.0
     dtype: str = "bfloat16"         # compute dtype hint (engine may override)
     # Random layerwise token dropping (reference csrc/random_ltd/ +

@@ -229,7 +229,53 @@ class CausalLM:
         use_rltd = (cfg.random_ltd and train and cache is None
                     and cfg.scan_layers and rltd_keep is not None
                     and rltd_keep < s and cfg.num_layers >= 3)
-        if use_rltd:
+        from ..comm import topology as topo_mod
+
+        wtopo = topo_mod._WORLD_TOPOLOGY
+        # cfg.pipe_stages (set by the engine from its topology) decides the
+        # trunk explicitly; the world-topology read is the fallback for
+        # direct model.loss() use. NOTE: the fallback is read at TRACE time —
+        # a jitted callable keeps the topology live at its first trace.
+        if cfg.pipe_stages is not None:
+            pipe_n = cfg.pipe_stages
+        else:
+            pipe_n = wtopo.axis_sizes.get("pipe", 1) if wtopo is not None else 1
+        if pipe_n > 1:
+            # Pipeline-parallel trunk (reference ``runtime/pipe/module.py:636``
+            # PipelineModule semantics, reachable from ``{"pipeline":
+            # {"stages": N}}``): embed/head stay outside the pipeline (the
+            # TiedLayerSpec pattern), the stacked layers run through the
+            # SPMD 1F1B ring over the ``pipe`` axis, composed with fsdp/tp
+            # via partial-manual shard_map.
+            if cache is not None:
+                raise NotImplementedError(
+                    "KV-cache decode through the pipeline is not supported; "
+                    "serve with a pipe=1 topology (the inference engines "
+                    "shard with TP instead)")
+            if use_rltd or pld_theta is not None:
+                raise ValueError(
+                    "pipeline parallelism is incompatible with random-LTD / "
+                    "progressive layer dropping (they restructure the stack)")
+            if not cfg.scan_layers:
+                raise ValueError("pipeline parallelism requires "
+                                 "scan_layers=True (stacked layer params)")
+            from ..parallel.pipeline import spmd_pipeline
+
+            lrngs = jax.random.split(rng, cfg.num_layers)
+            stacked = {"w": params["layers"], "rng": lrngs}
+
+            def pp_layer(lp, h, ex):
+                pos, seg = ex
+                h2, _, aux = self._layer(lp["w"], h, pos, seg, None,
+                                         lp["rng"])
+                return h2, aux
+
+            x, aux_total = spmd_pipeline(
+                pp_layer, stacked, x, wtopo,
+                n_microbatches=cfg.pipe_microbatches,
+                remat=cfg.remat, extras=(positions, segment_ids),
+                with_aux=True)
+        elif use_rltd:
             # Random layerwise token dropping (reference csrc/random_ltd/
             # token_sort/gather_scatter kernels + data_routing/basic_layer):
             # first and last layers see every token; the middle stack runs on
@@ -420,7 +466,22 @@ class CausalLM:
         names = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
         s = "/".join(str(n) for n in names)
         stacked = "layers" in names and self.config.scan_layers
-        pre = (None,) if stacked else ()
+        if stacked:
+            # under pipeline parallelism the stacked layer dim shards over
+            # ``pipe`` (each stage owns its contiguous layer block — the
+            # PipelineModule partitioning); otherwise it must never shard
+            # (scan iterates it). cfg.pipe_stages (engine-set) decides;
+            # world topology is the direct-use fallback.
+            if self.config.pipe_stages is not None:
+                pipe = self.config.pipe_stages > 1
+            else:
+                from ..comm import topology as topo_mod
+
+                t = topo_mod._WORLD_TOPOLOGY
+                pipe = (t is not None and t.axis_sizes.get("pipe", 1) > 1)
+            pre: Tuple = ("pipe",) if pipe else (None,)
+        else:
+            pre = ()
 
         if s.endswith("embed/embedding"):
             return ("model", "fsdp")
@@ -444,8 +505,8 @@ class CausalLM:
         if s.endswith("moe/w_down"):
             return pre + ("expert", "model", "fsdp")
         if s.endswith("scale"):
-            return None  # norm scales replicate
-        return None
+            return pre or None  # norm scales replicate (per pipe stage)
+        return pre or None
 
 
 def build_model(name_or_config, **overrides) -> CausalLM:

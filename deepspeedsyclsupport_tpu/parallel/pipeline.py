@@ -252,16 +252,20 @@ def partition_uniform(num_layers: int, num_parts: int) -> List[int]:
 
 
 def _spmd_pipeline_body(stage_fn: Callable, local_params: Any, x: jnp.ndarray,
-                        axis: str, extras: Tuple = ()) -> jnp.ndarray:
+                        extras: Any, axis: str
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """shard_map body: collective 1F1B-equivalent pipeline over ``axis``.
 
     ``x``: [n_micro, mb, ...] microbatched activations, replicated over ``axis``
     (only stage 0 reads them). ``local_params``: this stage's layer stack.
-    Returns [n_micro, mb, ...] outputs, valid on the LAST stage (garbage
-    elsewhere); callers broadcast via masked psum if needed.
+    ``extras``: pytree of [n_micro, ...] per-microbatch side inputs (positions,
+    segment ids) that travel WITH each microbatch along the ring.
+    ``stage_fn(local_params, h, extras_mb) -> (h, aux)``.
+    Returns ([n_micro, mb, ...] outputs, [n_micro] aux sums), valid on the
+    LAST stage (garbage elsewhere); callers broadcast via masked psum.
 
     Clock loop (reference ``_exec_schedule`` ``pipe/engine.py:1357``): at tick t,
-    stage s computes microbatch (t - s) if in range; the carried ``state`` then
+    stage s computes microbatch (t - s) if in range; the carried state then
     rotates one hop along the ring (``ppermute`` = the p2p SendActivation/
     RecvActivation pair, ``pipe/p2p.py``), so activations reach stage s+1 at tick
     t+1. Total ticks = n_micro + n_stages - 1 (fill + steady + drain).
@@ -272,24 +276,38 @@ def _spmd_pipeline_body(stage_fn: Callable, local_params: Any, x: jnp.ndarray,
     ticks = n_micro + n_stages - 1
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
+    def mb_at(tree, t):
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_index_in_dim(
+                a, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False), tree)
+
     def tick(carry, t):
-        state, outputs = carry
-        inp = jax.lax.dynamic_index_in_dim(
-            x, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
-        state_in = jnp.where(stage == 0, inp.astype(state.dtype), state)
-        out = stage_fn(local_params, state_in, *extras)
+        (h, aux, ex), outputs, aux_out = carry
+        h_in = jnp.where(stage == 0, mb_at(x, t).astype(h.dtype), h)
+        ex_in = jax.tree_util.tree_map(
+            lambda fresh, rot: jnp.where(stage == 0, fresh, rot),
+            mb_at(extras, t), ex)
+        aux_in = jnp.where(stage == 0, 0.0, aux)
+        out, aux_add = stage_fn(local_params, h_in, ex_in)
+        aux_mb = aux_in + aux_add
         out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
         valid = (stage == n_stages - 1) & (t >= n_stages - 1)
         cur = jax.lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
         outputs = jax.lax.dynamic_update_index_in_dim(
             outputs, jnp.where(valid, out, cur), out_idx, 0)
-        state = jax.lax.ppermute(out, axis, perm)
-        return (state, outputs), None
+        cur_a = jax.lax.dynamic_index_in_dim(aux_out, out_idx, 0,
+                                             keepdims=False)
+        aux_out = jax.lax.dynamic_update_index_in_dim(
+            aux_out, jnp.where(valid, aux_mb, cur_a), out_idx, 0)
+        h, aux, ex = jax.tree_util.tree_map(
+            lambda a: jax.lax.ppermute(a, axis, perm), (out, aux_mb, ex_in))
+        return ((h, aux, ex), outputs, aux_out), None
 
-    state0 = jnp.zeros_like(x[0])
-    outputs0 = jnp.zeros_like(x)
-    (_, outputs), _ = jax.lax.scan(tick, (state0, outputs0), jnp.arange(ticks))
-    return outputs
+    state0 = (jnp.zeros_like(x[0]), jnp.zeros((), jnp.float32),
+              jax.tree_util.tree_map(jnp.zeros_like, mb_at(extras, 0)))
+    carry0 = (state0, jnp.zeros_like(x), jnp.zeros((n_micro,), jnp.float32))
+    ((_, outputs, aux_out), _) = jax.lax.scan(tick, carry0, jnp.arange(ticks))
+    return outputs, aux_out
 
 
 def broadcast_from_last(y: jnp.ndarray, axis: str = "pipe") -> jnp.ndarray:
@@ -297,25 +315,44 @@ def broadcast_from_last(y: jnp.ndarray, axis: str = "pipe") -> jnp.ndarray:
     reference's final loss broadcast, ``pipe/engine.py`` train_batch tail)."""
     from ..comm import comm
 
+    if y.dtype == jnp.bfloat16 and jax.default_backend() != "tpu":
+        # XLA CPU's AllReducePromotion pass aborts cloning this bf16
+        # all-reduce inside the partial-manual region (hlo_instruction.cc
+        # "Invalid binary instruction opcode copy"); route around it off-TPU
+        return broadcast_from_last(y.astype(jnp.float32),
+                                   axis).astype(jnp.bfloat16)
     n_stages = jax.lax.psum(1, axis)
     return comm.broadcast(y, axis, src=n_stages - 1)
 
 
-def spmd_pipeline(layer_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+def spmd_pipeline(layer_fn: Callable,
                   stacked_params: Any,
                   x: jnp.ndarray,
                   topology: MeshTopology,
                   *,
                   n_microbatches: Optional[int] = None,
                   remat: bool = True,
-                  batch_axes: Tuple[str, ...] = ("data", "fsdp")) -> jnp.ndarray:
+                  batch_axes: Tuple[str, ...] = ("data", "fsdp"),
+                  extras: Any = (),
+                  with_aux: bool = False):
     """Run a stack of homogeneous layers as a pipeline over the ``pipe`` axis.
 
     ``layer_fn(layer_params, h) -> h`` — one layer, uniform activation shape
     (the transformer-trunk contract; embed/head run outside the pipeline).
+    With ``with_aux=True`` the contract is ``layer_fn(layer_params, h,
+    extras) -> (h, aux)`` where ``extras`` is a pytree of [batch, ...]
+    per-sample side inputs (positions, segment ids) that is microbatched and
+    travels with each microbatch, and ``aux`` is a scalar summed over layers
+    and microbatches (MoE aux losses) — the return becomes ``(y, aux_sum)``.
     ``stacked_params``: pytree with leading layer dim L on every leaf (the
     scan-over-layers layout); sharded over ``pipe`` on that dim.
     ``x``: [batch, ...] activations; reshaped to [n_micro, mb, ...] internally.
+
+    The shard_map is MANUAL over ``pipe`` only (``axis_names={'pipe'}``):
+    fsdp/tp/expert shardings inside the stage body stay under GSPMD, so the
+    pipeline composes with ZeRO-3 and tensor parallelism instead of
+    gathering their shards (the reference composes PipelineEngine with ZeRO
+    the same way — stage-local DP groups, ``runtime/pipe/engine.py:55``).
 
     Differentiable: ``jax.grad`` through this yields the reverse (backward)
     pipeline schedule automatically.
@@ -324,26 +361,42 @@ def spmd_pipeline(layer_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     n_micro = n_microbatches or max(n_stages, 1)
     mesh = topology.mesh
 
-    def scan_layers(local_params, h):
+    def scan_layers(local_params, h, ex):
+        if with_aux:
+            def body(carry, lp):
+                hh, aux = carry
+                hh, a = layer_fn(lp, hh, ex)
+                return (hh, aux + a), None
+
+            (h, aux), _ = jax.lax.scan(
+                body, (h, jnp.zeros((), jnp.float32)), local_params)
+            return h, aux
+
         def body(hh, lp):
             return layer_fn(lp, hh), None
+
         out, _ = jax.lax.scan(body, h, local_params)
-        return out
+        return out, jnp.zeros((), jnp.float32)
 
     stage_fn = jax.checkpoint(scan_layers) if remat else scan_layers
 
     if n_stages == 1:
-        return stage_fn(stacked_params, x)
+        y, aux = stage_fn(stacked_params, x, extras)
+        return (y, aux) if with_aux else y
 
     assert x.shape[0] % n_micro == 0, (
         f"batch {x.shape[0]} not divisible by n_microbatches {n_micro}")
-    xm = x.reshape((n_micro, x.shape[0] // n_micro) + x.shape[1:])
-
-    param_specs = jax.tree_util.tree_map(
-        lambda p: P("pipe", *([None] * (p.ndim - 1))), stacked_params)
-    # Shard the microbatch dim over the largest prefix of batch_axes that
-    # divides it (dropping an axis replicates the work across it — warn).
     mb = x.shape[0] // n_micro
+
+    def microbatch(a):
+        return a.reshape((n_micro, a.shape[0] // n_micro) + a.shape[1:])
+
+    xm = microbatch(x)
+    exm = jax.tree_util.tree_map(microbatch, extras)
+
+    # Keep the microbatch dim sharded over the largest prefix of batch_axes
+    # that divides it (these axes stay AUTO — the constraint just guides
+    # GSPMD; dropping an axis replicates the work across it — warn).
     kept: Tuple[str, ...] = batch_axes
     while kept and mb % int(np.prod([topology.axis_sizes[a] for a in kept])) != 0:
         kept = kept[:-1]
@@ -354,18 +407,41 @@ def spmd_pipeline(layer_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
             "pipeline microbatch size %d not divisible by %s sizes; sharding "
             "over %s only (rest replicated — consider fewer microbatches)",
             mb, batch_axes, kept or "nothing")
-    x_spec = P(None, kept if kept else None, *([None] * (x.ndim - 1)))
+    if kept:
+        xm = jax.lax.with_sharding_constraint(
+            xm, topology.sharding(None, kept))
 
-    def body(local_params, xmb):
-        # Output lives on the last stage only; broadcast so the out_spec
-        # (which has no 'pipe' axis) is valid on every rank.
-        return broadcast_from_last(
-            _spmd_pipeline_body(stage_fn, local_params, xmb, "pipe"), "pipe")
+    # Specs constrain ONLY the manual axis ('pipe'): the stacked layer dim
+    # splits into per-stage stacks; activations/extras replicate over pipe.
+    param_specs = jax.tree_util.tree_map(lambda p: P("pipe"), stacked_params)
+    ex_specs = jax.tree_util.tree_map(lambda e: P(), exm)
 
-    y = jax.shard_map(
-        body, mesh=mesh, in_specs=(param_specs, x_spec),
-        out_specs=x_spec, check_vma=False)(stacked_params, xm)
-    return y.reshape(x.shape)
+    # Off-TPU, bf16 values must not cross the manual-region boundary: the AD
+    # transpose of the replicated-over-pipe input is a bf16 psum, which
+    # XLA CPU's AllReducePromotion pass aborts on (see broadcast_from_last).
+    compute_dtype = x.dtype
+    boundary_cast = (compute_dtype == jnp.bfloat16
+                     and jax.default_backend() != "tpu")
+    if boundary_cast:
+        xm = xm.astype(jnp.float32)
+
+    def body(local_params, xmb, ex):
+        # Output lives on the last stage only; broadcast so every pipe rank
+        # returns the same (replicated-over-pipe) value.
+        out, aux = _spmd_pipeline_body(stage_fn, local_params,
+                                       xmb.astype(compute_dtype), ex, "pipe")
+        return (broadcast_from_last(out, "pipe"),
+                broadcast_from_last(aux, "pipe"))
+
+    # jit wrapper: the partial-manual (axis_names={'pipe'}) shard_map only
+    # lowers under a jit trace; eager callers (tests, scripts) hit a
+    # different impl path that rejects auto axes
+    y, aux = jax.jit(jax.shard_map(
+        body, mesh=mesh, axis_names={"pipe"},
+        in_specs=(param_specs, P(), ex_specs),
+        out_specs=(P(), P()), check_vma=False))(stacked_params, xm, exm)
+    y = y.reshape(x.shape)
+    return (y, aux.sum()) if with_aux else y
 
 
 # ============================================================================

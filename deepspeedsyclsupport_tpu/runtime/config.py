@@ -176,13 +176,19 @@ class ParallelismConfig:
     pp: int = 1
     ep: int = 1
     sp: int = 1
+    # pipeline microbatches per forward (pipeline.micro_batches; None =>
+    # one per stage) — reference PipelineEngine streams GAS microbatches
+    pp_microbatches: Optional[int] = None
 
     @classmethod
     def from_config_dict(cls, d: Dict[str, Any], zero_stage: int,
                          mics_shard_size: int = -1) -> "ParallelismConfig":
         p = _sub(d, C.PARALLELISM)
         tp = int(p.get("tp", _sub(d, C.TENSOR_PARALLEL).get("tp_size", 1)))
-        pp = int(p.get("pp", _sub(d, C.PIPELINE).get("stages", 1)))
+        pipe_sec = _sub(d, C.PIPELINE)
+        pp = int(p.get("pp", pipe_sec.get("stages", 1)))
+        pp_micro = pipe_sec.get("micro_batches")
+        pp_micro = int(pp_micro) if pp_micro is not None else None
         ep = int(p.get("ep", _sub(d, C.MOE).get("expert_parallel_size", 1)))
         sp = int(p.get("sp", d.get(C.SEQUENCE_PARALLEL_SIZE, 1)))
         fsdp = int(p.get("fsdp", 0)) or 0
@@ -209,7 +215,8 @@ class ParallelismConfig:
             fsdp = 1
         elif not dp:
             dp = 1
-        return cls(dp=dp, fsdp=fsdp, tp=tp, pp=pp, ep=ep, sp=sp)
+        return cls(dp=dp, fsdp=fsdp, tp=tp, pp=pp, ep=ep, sp=sp,
+                   pp_microbatches=pp_micro)
 
 
 @dataclass

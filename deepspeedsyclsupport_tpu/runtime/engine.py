@@ -129,6 +129,14 @@ class Engine:
                                                    pp=p.pp, ep=p.ep, sp=p.sp)
         self.dp_world_size = self.topology.get_data_parallel_world_size()
         self.config.resolve_batch_sizes(self.dp_world_size)
+        mcfg = getattr(self.module, "config", None)
+        if hasattr(mcfg, "pipe_stages"):
+            # make the pipelined trunk an explicit model-config property
+            # (reference: PipelineEngine owns its stage count; micro_batches
+            # is the pipeline.micro_batches knob)
+            mcfg.pipe_stages = self.topology.axis_sizes["pipe"]
+            if p.pp_microbatches:
+                mcfg.pipe_microbatches = p.pp_microbatches
 
         comms_logger.configure(enabled=self.config.comms_logger.enabled,
                                verbose=self.config.comms_logger.verbose)

