@@ -256,6 +256,11 @@ class CausalLM:
                 raise ValueError(
                     "pipeline parallelism is incompatible with random-LTD / "
                     "progressive layer dropping (they restructure the stack)")
+            if kv_mask is not None or kv_positions is not None:
+                raise NotImplementedError(
+                    "kv_mask/kv_positions are not supported through the "
+                    "pipelined trunk (they are decode-path arguments; train "
+                    "packing uses segment_ids, which IS supported)")
             if not cfg.scan_layers:
                 raise ValueError("pipeline parallelism requires "
                                  "scan_layers=True (stacked layer params)")

@@ -134,7 +134,14 @@ class Engine:
             # make the pipelined trunk an explicit model-config property
             # (reference: PipelineEngine owns its stage count; micro_batches
             # is the pipeline.micro_batches knob)
-            mcfg.pipe_stages = self.topology.axis_sizes["pipe"]
+            pipe_now = self.topology.axis_sizes["pipe"]
+            if mcfg.pipe_stages is not None and mcfg.pipe_stages != pipe_now:
+                logger.warning(
+                    "model config pipe_stages %d overwritten to %d — this "
+                    "model is shared with an engine built on a different "
+                    "pipe topology; functions that engine traced earlier "
+                    "keep the old trunk", mcfg.pipe_stages, pipe_now)
+            mcfg.pipe_stages = pipe_now
             if p.pp_microbatches:
                 mcfg.pipe_microbatches = p.pp_microbatches
 
@@ -207,8 +214,19 @@ class Engine:
         # ---------------------------------------------------------- placement
         stage = self.config.zero.stage
         self.zero_stage = stage
+        self._sharding_rules = sharding_rules
         self.param_shardings = zero_lib.tree_param_shardings(
             params, self.topology, stage, extra_rules=sharding_rules)
+        # Stage >= 2: gradients (and the fp32 grad accumulator the scan
+        # carries) live fsdp-sharded — the reference's IPG reduce-scatter
+        # bucketing (``stage_1_and_2.py:894,1004``). The layout is exactly
+        # the stage-3 param layout (TP dims composed, largest free dim over
+        # fsdp). Computed before offload init: the multi-host offload path
+        # reuses it as its shard layout.
+        self.grad_shardings = None
+        if stage >= 2 and self.topology.axis_sizes["fsdp"] > 1:
+            self.grad_shardings = zero_lib.tree_param_shardings(
+                params, self.topology, 3, extra_rules=sharding_rules)
 
         # -------------------------------------------------------- offload
         # ZeRO-Offload / ZeRO-Infinity (reference: cpu_adam host step
@@ -222,15 +240,32 @@ class Engine:
         off_opt = self.config.zero.offload_optimizer
         off_par = self.config.zero.offload_param
         self.offload_device = None
+        self._mh_offload = None     # multi-controller per-host shard swapping
+        self._mh_push_fn = None
+        self._multihost = False
         if off_opt.enabled or off_par.enabled:
             if jax.process_count() > 1:
-                # grads would need a cross-host gather to reach one host's
-                # optimizer; the multi-controller offload story is per-host
-                # shard swapping, not yet wired
-                raise NotImplementedError(
-                    "offload is single-controller only for now (multi-host "
-                    "runs keep optimizer state on device; use zero stage 1-3 "
-                    "sharding instead)")
+                # per-host shard swapping (reference: CPUAdam partition
+                # updates per rank + cross-rank grad-norm allreduce,
+                # stage_1_and_2.py cpu_offload / stage3.py:1816): each
+                # controller owns its fsdp shard's fp32 master + moments
+                t = self.config.optimizer.type.lower().replace("_", "")
+                if "nvme" in (off_opt.device, off_par.device):
+                    raise NotImplementedError(
+                        "multi-host NVMe offload not wired yet; use "
+                        "device='cpu' (per-host NVMe swap is single-"
+                        "controller only)")
+                if t not in ("adam", "adamw", "fusedadam", "cpuadam"):
+                    raise ValueError(
+                        "multi-host offload implements CPU Adam/AdamW only "
+                        "(the reference's CPUAdam is likewise the only "
+                        "offload optimizer); got optimizer type "
+                        f"{self.config.optimizer.type!r}")
+                if stage < 2 or self.topology.axis_sizes["fsdp"] <= 1:
+                    raise ValueError(
+                        "multi-host offload needs zero stage >= 2 with "
+                        "fsdp > 1 so gradients land host-disjoint")
+                self._multihost = True
             self.offload_device = ("nvme" if "nvme" in (off_opt.device,
                                                         off_par.device)
                                    else "cpu")
@@ -248,17 +283,6 @@ class Engine:
                 stage)
             self.opt_state = jax.jit(
                 tx.init, out_shardings=self.opt_shardings)(self.params)
-        # Stage >= 2: gradients (and the fp32 grad accumulator the scan carries)
-        # live fsdp-sharded — the reference's IPG reduce-scatter bucketing
-        # (``stage_1_and_2.py:894,1004``). The layout is exactly the stage-3
-        # param layout (TP dims composed, largest free dim over fsdp), enforced
-        # by a sharding constraint at the microbatch boundary so XLA
-        # reduce-scatters each microbatch's grads instead of carrying a
-        # replicated full-size accumulator.
-        self.grad_shardings = None
-        if stage >= 2 and self.topology.axis_sizes["fsdp"] > 1:
-            self.grad_shardings = zero_lib.tree_param_shardings(
-                params, self.topology, 3, extra_rules=sharding_rules)
         log_dist(zero_lib.describe_memory_plan(self.params, self.topology,
                                                stage, self.offload_device))
 
@@ -351,6 +375,31 @@ class Engine:
     # ================================================================ offload
     def _init_offload(self, params, tx, off_opt, off_par):
         """Host-resident fp32 master + moments; compute-dtype device params."""
+        if self._multihost:
+            from .multihost_offload import MultiHostCPUAdam
+            from .optimizers import _common
+
+            opt_params = self.config.optimizer.params
+            _, betas, eps, wd = _common(opt_params)
+            t = self.config.optimizer.type.lower().replace("_", "")
+            # mirror build_optimizer: plain "adam" with adam_w_mode=False is
+            # optax.adam — no weight decay at all
+            if t == "adam" and not opt_params.get("adam_w_mode", True):
+                wd = 0.0
+            fp16 = self.config.fp16
+            self._mh_offload = MultiHostCPUAdam(
+                params, self.grad_shardings, betas=betas, eps=eps,
+                weight_decay=wd,
+                clip=self.config.gradient_clipping,
+                lr_fn=lambda step: float(np.asarray(
+                    self.lr_schedule(step)
+                    if callable(self.lr_schedule) else self.lr_schedule)),
+                fp16_cfg=fp16, fp16_enabled=self.fp16_enabled)
+            self.master_params = None
+            self.opt_state = None
+            self.opt_shardings = None
+            self.params = self._push_params_to_device(params)
+            return
         cpu = jax.local_devices(backend="cpu")[0]
         self._cpu_device = cpu
 
@@ -377,6 +426,22 @@ class Engine:
         log_dist(f"offload: master+optimizer on "
                  f"{'NVMe(' + self._swapper.swap_dir + ')' if self._swapper else 'host CPU'}, "
                  f"device params dtype={jnp.dtype(self.compute_dtype).name}")
+
+    def _mh_push(self, master_tree):
+        """Jitted cast+reshard: shard (ZeRO-3) layout fp32 master → working
+        param layout in compute dtype; any cross-host gather rides the
+        ICI/DCN interconnect on device, never the hosts."""
+        if self._mh_push_fn is None:
+            dtype = self.compute_dtype
+
+            def push(t):
+                return jax.tree_util.tree_map(
+                    lambda x: x.astype(dtype)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x, t)
+
+            self._mh_push_fn = jax.jit(push,
+                                       out_shardings=self.param_shardings)
+        return self._mh_push_fn(master_tree)
 
     def _push_params_to_device(self, master_tree):
         """Compute-dtype device working copies from the fp32 host master.
@@ -487,6 +552,11 @@ class Engine:
     def _host_step(self, grads):
         """Shared tail of an offloaded step: grads → host, (swap in,) fp32
         master update on CPU, (swap out,) push compute-dtype params back."""
+        if self._mh_offload is not None:
+            new_master, self.scaler_state, m2 = self._mh_offload.step(
+                grads, self.scaler_state)
+            self.params = self._mh_push(new_master)
+            return m2
         if self._host_apply is None:
             self._host_apply = self._build_host_apply_fn()
         host_grads = jax.tree_util.tree_map(
@@ -878,7 +948,13 @@ class Engine:
         tag = tag or f"global_step{self.global_steps}"
         self._validate_tag(tag)
         path = os.path.join(save_dir, tag)
-        if self.offload_device is not None:
+        if self._mh_offload is not None:
+            # per-host master/moment shards reassemble into global arrays;
+            # orbax writes them multi-controller like any sharded tree
+            state = {"params": self._mh_offload.master_global_tree(),
+                     "opt_state": self._mh_offload.moments_global_tree(),
+                     "scaler": self.scaler_state}
+        elif self.offload_device is not None:
             # persist the fp32 master copy (device params are lossy bf16)
             if self._swapper is not None and self.opt_state is None:
                 self._swap_in_opt_state()
@@ -926,7 +1002,23 @@ class Engine:
         path = os.path.join(load_dir, tag)
         repl = self.topology.replicated()
         scaler_sh = jax.tree_util.tree_map(lambda _: repl, self.scaler_state)
-        if self.offload_device is not None:
+        if self._mh_offload is not None:
+            mh = self._mh_offload
+            mom = mh.moments_global_tree()
+            template = {
+                "params": (mh.master_global_tree(), mh.shard_shardings),
+                "opt_state": (mom, {"m": mh.shard_shardings,
+                                    "v": mh.shard_shardings,
+                                    "step": repl}),
+                "scaler": (self.scaler_state, scaler_sh)}
+            state, meta = load_tree(path, template)
+            mh.load_state(state["params"],
+                          state["opt_state"] if load_optimizer_states
+                          else None)
+            if load_optimizer_states:
+                self.scaler_state = state["scaler"]
+            self.params = self._mh_push(mh.master_global_tree())
+        elif self.offload_device is not None:
             if self._swapper is not None and self.opt_state is None:
                 self._swap_in_opt_state()  # template needs the live tree
             cpu = self._cpu_device
