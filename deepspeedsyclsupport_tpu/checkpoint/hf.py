@@ -45,7 +45,8 @@ SAFE_SINGLE = "model.safetensors"
 BIN_INDEX = "pytorch_model.bin.index.json"
 BIN_SINGLE = "pytorch_model.bin"
 # top-level module prefixes that HF exports variously carry or drop
-_MODULE_PREFIXES = ("transformer.", "model.", "gpt_neox.")
+_MODULE_PREFIXES = ("transformer.", "model.", "gpt_neox.", "bert.",
+                    "distilbert.")
 
 
 # --------------------------------------------------------------------- config
@@ -282,15 +283,17 @@ class HFCheckpointSource:
         wrong family map fails loudly instead of quietly mis-loading."""
         if name in self._name_to_file:
             return name
-        if self._ckpt_prefix is not None:
-            if not name.startswith(self._ckpt_prefix):
-                cand = self._ckpt_prefix + name
-                if cand in self._name_to_file:
-                    return cand
-            return None
+        # strip one leading module level (encoder-only exports drop the
+        # outermost module: 'distilbert.transformer.layer...' is stored as
+        # 'transformer.layer...'); exact matches always win above
         for pre in _MODULE_PREFIXES:
             if name.startswith(pre) and name[len(pre):] in self._name_to_file:
                 return name[len(pre):]
+        if (self._ckpt_prefix is not None
+                and not name.startswith(self._ckpt_prefix)):
+            cand = self._ckpt_prefix + name
+            if cand in self._name_to_file:
+                return cand
         return None
 
     def _load_bin(self, fname: str) -> Dict[str, Any]:
@@ -844,4 +847,302 @@ def load_hf_checkpoint(path: str,
             for p in jax.tree_util.tree_leaves(params))
     log_dist(f"loaded HF checkpoint {path} ({mt}): {n/1e6:.1f}M params "
              f"({'safetensors' if src._use_safetensors else 'torch bins'})")
+    return model, params
+
+
+# ======================================================================
+# Encoder families: BERT / DistilBERT (reference containers/bert.py,
+# distil_bert.py) and CLIP (containers/clip.py)
+# ======================================================================
+def encoder_config_from_hf(hf: Dict[str, Any], **overrides):
+    """HF ``config.json`` → :class:`models.encoder.EncoderConfig`."""
+    from ..models.encoder import EncoderConfig
+
+    mt = hf.get("model_type", "bert")
+    if mt == "bert":
+        kw = dict(vocab_size=hf.get("vocab_size", 30522),
+                  hidden_size=hf.get("hidden_size", 768),
+                  intermediate_size=hf.get("intermediate_size", 3072),
+                  num_layers=hf.get("num_hidden_layers", 12),
+                  num_heads=hf.get("num_attention_heads", 12),
+                  max_seq_len=hf.get("max_position_embeddings", 512),
+                  type_vocab_size=hf.get("type_vocab_size", 2),
+                  layer_norm_eps=float(hf.get("layer_norm_eps", 1e-12)),
+                  activation=_map_activation(hf.get("hidden_act", "gelu")))
+    elif mt == "distilbert":
+        kw = dict(vocab_size=hf.get("vocab_size", 30522),
+                  hidden_size=hf.get("dim", 768),
+                  intermediate_size=hf.get("hidden_dim", 3072),
+                  num_layers=hf.get("n_layers", 6),
+                  num_heads=hf.get("n_heads", 12),
+                  max_seq_len=hf.get("max_position_embeddings", 512),
+                  type_vocab_size=0,
+                  layer_norm_eps=1e-12,
+                  activation=_map_activation(hf.get("activation", "gelu")))
+    else:
+        raise ValueError(f"not an encoder model_type: {mt!r}")
+    kw.update(overrides)
+    return EncoderConfig(**kw)
+
+
+def _bert_maps(cfg):
+    top = {
+        ("embed", "word"): ("bert.embeddings.word_embeddings.weight", _id),
+        ("embed", "pos"): ("bert.embeddings.position_embeddings.weight", _id),
+        ("embed", "type"): ("bert.embeddings.token_type_embeddings.weight",
+                            _id),
+        ("embed_norm", "scale"): ("bert.embeddings.LayerNorm.weight", _id),
+        ("embed_norm", "bias"): ("bert.embeddings.LayerNorm.bias", _id),
+        ("mlm", "dense"): ("cls.predictions.transform.dense.weight", _t),
+        ("mlm", "bias_d"): ("cls.predictions.transform.dense.bias", _id),
+        ("mlm", "norm", "scale"):
+            ("cls.predictions.transform.LayerNorm.weight", _id),
+        ("mlm", "norm", "bias"):
+            ("cls.predictions.transform.LayerNorm.bias", _id),
+        ("mlm", "decoder_bias"): ("cls.predictions.bias", _id),
+        ("pooler", "w"): ("bert.pooler.dense.weight", _t),
+        ("pooler", "b"): ("bert.pooler.dense.bias", _id),
+    }
+
+    def layer(i):
+        b = f"bert.encoder.layer.{i}."
+        return {
+            ("attn", "wq"): (b + "attention.self.query.weight", _t),
+            ("attn", "bq"): (b + "attention.self.query.bias", _id),
+            ("attn", "wk"): (b + "attention.self.key.weight", _t),
+            ("attn", "bk"): (b + "attention.self.key.bias", _id),
+            ("attn", "wv"): (b + "attention.self.value.weight", _t),
+            ("attn", "bv"): (b + "attention.self.value.bias", _id),
+            ("attn", "wo"): (b + "attention.output.dense.weight", _t),
+            ("attn", "bo"): (b + "attention.output.dense.bias", _id),
+            ("attn_norm", "scale"):
+                (b + "attention.output.LayerNorm.weight", _id),
+            ("attn_norm", "bias"):
+                (b + "attention.output.LayerNorm.bias", _id),
+            ("mlp", "fc1"): (b + "intermediate.dense.weight", _t),
+            ("mlp", "b1"): (b + "intermediate.dense.bias", _id),
+            ("mlp", "fc2"): (b + "output.dense.weight", _t),
+            ("mlp", "b2"): (b + "output.dense.bias", _id),
+            ("mlp_norm", "scale"): (b + "output.LayerNorm.weight", _id),
+            ("mlp_norm", "bias"): (b + "output.LayerNorm.bias", _id),
+        }
+
+    return top, layer
+
+
+def _distilbert_maps(cfg):
+    top = {
+        ("embed", "word"):
+            ("distilbert.embeddings.word_embeddings.weight", _id),
+        ("embed", "pos"):
+            ("distilbert.embeddings.position_embeddings.weight", _id),
+        ("embed_norm", "scale"): ("distilbert.embeddings.LayerNorm.weight",
+                                  _id),
+        ("embed_norm", "bias"): ("distilbert.embeddings.LayerNorm.bias",
+                                 _id),
+        ("mlm", "dense"): ("vocab_transform.weight", _t),
+        ("mlm", "bias_d"): ("vocab_transform.bias", _id),
+        ("mlm", "norm", "scale"): ("vocab_layer_norm.weight", _id),
+        ("mlm", "norm", "bias"): ("vocab_layer_norm.bias", _id),
+        ("mlm", "decoder"): ("vocab_projector.weight", _t),
+        ("mlm", "decoder_bias"): ("vocab_projector.bias", _id),
+    }
+
+    def layer(i):
+        b = f"distilbert.transformer.layer.{i}."
+        return {
+            ("attn", "wq"): (b + "attention.q_lin.weight", _t),
+            ("attn", "bq"): (b + "attention.q_lin.bias", _id),
+            ("attn", "wk"): (b + "attention.k_lin.weight", _t),
+            ("attn", "bk"): (b + "attention.k_lin.bias", _id),
+            ("attn", "wv"): (b + "attention.v_lin.weight", _t),
+            ("attn", "bv"): (b + "attention.v_lin.bias", _id),
+            ("attn", "wo"): (b + "attention.out_lin.weight", _t),
+            ("attn", "bo"): (b + "attention.out_lin.bias", _id),
+            ("attn_norm", "scale"): (b + "sa_layer_norm.weight", _id),
+            ("attn_norm", "bias"): (b + "sa_layer_norm.bias", _id),
+            ("mlp", "fc1"): (b + "ffn.lin1.weight", _t),
+            ("mlp", "b1"): (b + "ffn.lin1.bias", _id),
+            ("mlp", "fc2"): (b + "ffn.lin2.weight", _t),
+            ("mlp", "b2"): (b + "ffn.lin2.bias", _id),
+            ("mlp_norm", "scale"): (b + "output_layer_norm.weight", _id),
+            ("mlp_norm", "bias"): (b + "output_layer_norm.bias", _id),
+        }
+
+    return top, layer
+
+
+def load_hf_encoder_checkpoint(path: str, dtype: Any = None,
+                               config_overrides: Optional[Dict] = None):
+    """Load an HF BERT/DistilBERT checkpoint → ``(BertModel, params)``.
+
+    Optional pieces absent from the export (pooler on MaskedLM saves, the
+    MLM head on encoder-only saves) keep their random init with a warning
+    — matching HF's "some weights were newly initialized" behavior.
+    """
+    from ..models.encoder import BertModel
+
+    with open(os.path.join(path, "config.json")) as f:
+        hf_cfg = json.load(f)
+    mt = hf_cfg.get("model_type", "bert")
+    cfg = encoder_config_from_hf(hf_cfg, **(config_overrides or {}))
+    src = HFCheckpointSource(path)
+    if mt == "distilbert":
+        # vocab_projector is tied to the word embeddings by default, and
+        # safetensors omits the shared tensor — tie when it's absent
+        tie = "vocab_projector.weight" not in src
+        model = BertModel(cfg, tie_mlm_decoder=tie)
+        top, layer = _distilbert_maps(cfg)
+        if tie:
+            top = {k: v for k, v in top.items() if k != ("mlm", "decoder")}
+    else:
+        model = BertModel(cfg)
+        top, layer = _bert_maps(cfg)
+    model.hf_config = hf_cfg
+    params = model.init_params()
+
+    def emit(tree, segs, val):
+        d = tree
+        for s in segs[:-1]:
+            d = d[s]
+        d[segs[-1]] = val
+
+    params = jax.tree_util.tree_map(np.asarray, params)  # mutable host tree
+    missing = []
+    for segs, (name, fn) in top.items():
+        if segs == ("embed", "type") and cfg.type_vocab_size == 0:
+            continue
+        if name in src:
+            emit(params, segs, fn(src.get(name)))
+        else:
+            missing.append(name)
+    for i in range(cfg.num_layers):
+        for segs, (name, fn) in layer(i).items():
+            arr = fn(src.get(name))
+            leaf = params["layers"]
+            for s in segs[:-1]:
+                leaf = leaf[s]
+            if i == 0:
+                leaf[segs[-1]] = np.empty((cfg.num_layers,) + arr.shape,
+                                          arr.dtype)
+            leaf[segs[-1]][i] = arr
+    if missing:
+        logger.warning("encoder checkpoint %s: %d heads kept at random "
+                       "init (absent from export): %s", path, len(missing),
+                       missing[:4])
+    if dtype is not None:
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(dtype)
+            if jnp.issubdtype(np.asarray(x).dtype, jnp.floating) else x,
+            params)
+    src.close()
+    log_dist(f"loaded HF encoder checkpoint {path} ({mt})")
+    return model, params
+
+
+def load_hf_clip_checkpoint(path: str, dtype: Any = None):
+    """Load an HF CLIPModel checkpoint → ``(CLIPModel, params)``
+    (reference ``module_inject/containers/clip.py`` parity surface)."""
+    from ..models.encoder import CLIPConfig, CLIPModel, EncoderConfig
+
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    tc, vc = hf["text_config"], hf["vision_config"]
+    cfg = CLIPConfig(
+        text=EncoderConfig(
+            vocab_size=tc.get("vocab_size", 49408),
+            hidden_size=tc.get("hidden_size", 512),
+            intermediate_size=tc.get("intermediate_size", 2048),
+            num_layers=tc.get("num_hidden_layers", 12),
+            num_heads=tc.get("num_attention_heads", 8),
+            max_seq_len=tc.get("max_position_embeddings", 77),
+            type_vocab_size=0,
+            layer_norm_eps=float(tc.get("layer_norm_eps", 1e-5)),
+            activation=("quick_gelu" if tc.get("hidden_act", "quick_gelu")
+                        == "quick_gelu" else
+                        _map_activation(tc["hidden_act"])),
+            norm_position="pre", causal=True),
+        vision=EncoderConfig(
+            vocab_size=0,
+            hidden_size=vc.get("hidden_size", 768),
+            intermediate_size=vc.get("intermediate_size", 3072),
+            num_layers=vc.get("num_hidden_layers", 12),
+            num_heads=vc.get("num_attention_heads", 12),
+            type_vocab_size=0,
+            layer_norm_eps=float(vc.get("layer_norm_eps", 1e-5)),
+            activation=("quick_gelu" if vc.get("hidden_act", "quick_gelu")
+                        == "quick_gelu" else
+                        _map_activation(vc["hidden_act"])),
+            norm_position="pre",
+            image_size=vc.get("image_size", 224),
+            patch_size=vc.get("patch_size", 32)),
+        projection_dim=hf.get("projection_dim", 512),
+        eos_token_id=tc.get("eos_token_id", hf.get("eos_token_id", 49407)))
+    model = CLIPModel(cfg)
+    model.hf_config = hf
+    src = HFCheckpointSource(path)
+    params = jax.tree_util.tree_map(np.asarray, model.init_params())
+
+    def tower_layers(prefix, tcfg, dest):
+        for i in range(tcfg.num_layers):
+            b = f"{prefix}.encoder.layers.{i}."
+            for segs, (name, fn) in {
+                ("attn", "wq"): (b + "self_attn.q_proj.weight", _t),
+                ("attn", "bq"): (b + "self_attn.q_proj.bias", _id),
+                ("attn", "wk"): (b + "self_attn.k_proj.weight", _t),
+                ("attn", "bk"): (b + "self_attn.k_proj.bias", _id),
+                ("attn", "wv"): (b + "self_attn.v_proj.weight", _t),
+                ("attn", "bv"): (b + "self_attn.v_proj.bias", _id),
+                ("attn", "wo"): (b + "self_attn.out_proj.weight", _t),
+                ("attn", "bo"): (b + "self_attn.out_proj.bias", _id),
+                ("attn_norm", "scale"): (b + "layer_norm1.weight", _id),
+                ("attn_norm", "bias"): (b + "layer_norm1.bias", _id),
+                ("mlp", "fc1"): (b + "mlp.fc1.weight", _t),
+                ("mlp", "b1"): (b + "mlp.fc1.bias", _id),
+                ("mlp", "fc2"): (b + "mlp.fc2.weight", _t),
+                ("mlp", "b2"): (b + "mlp.fc2.bias", _id),
+                ("mlp_norm", "scale"): (b + "layer_norm2.weight", _id),
+                ("mlp_norm", "bias"): (b + "layer_norm2.bias", _id),
+            }.items():
+                arr = fn(src.get(name))
+                leaf = dest
+                for s in segs[:-1]:
+                    leaf = leaf[s]
+                if i == 0:
+                    leaf[segs[-1]] = np.empty(
+                        (tcfg.num_layers,) + arr.shape, arr.dtype)
+                leaf[segs[-1]][i] = arr
+
+    t = params["text"]
+    t["embed"]["word"] = src.get("text_model.embeddings.token_embedding.weight")
+    t["embed"]["pos"] = src.get(
+        "text_model.embeddings.position_embedding.weight")
+    tower_layers("text_model", cfg.text, t["layers"])
+    t["final_norm"]["scale"] = src.get("text_model.final_layer_norm.weight")
+    t["final_norm"]["bias"] = src.get("text_model.final_layer_norm.bias")
+
+    v = params["vision"]
+    v["class_embed"] = src.get("vision_model.embeddings.class_embedding")
+    pw = src.get("vision_model.embeddings.patch_embedding.weight")
+    # torch conv [D, 3, p, p] → matmul [(p·p·3), D] in (ph, pw, c) order
+    v["patch_embed"] = np.transpose(pw, (2, 3, 1, 0)).reshape(-1, pw.shape[0])
+    v["pos_embed"] = src.get(
+        "vision_model.embeddings.position_embedding.weight")
+    # sic: HF ships this layer as "pre_layrnorm"
+    v["pre_norm"]["scale"] = src.get("vision_model.pre_layrnorm.weight")
+    v["pre_norm"]["bias"] = src.get("vision_model.pre_layrnorm.bias")
+    tower_layers("vision_model", cfg.vision, v["layers"])
+    v["post_norm"]["scale"] = src.get("vision_model.post_layernorm.weight")
+    v["post_norm"]["bias"] = src.get("vision_model.post_layernorm.bias")
+
+    params["text_projection"] = _t(src.get("text_projection.weight"))
+    params["visual_projection"] = _t(src.get("visual_projection.weight"))
+    params["logit_scale"] = src.get("logit_scale")
+    if dtype is not None:
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(dtype)
+            if jnp.issubdtype(np.asarray(x).dtype, jnp.floating) else x,
+            params)
+    src.close()
+    log_dist(f"loaded HF CLIP checkpoint {path}")
     return model, params

@@ -1,0 +1,425 @@
+"""Encoder architectures: BERT-family masked-LM models and CLIP dual towers.
+
+Reference analog: the encoder half of ``module_inject``'s policy zoo —
+``deepspeed/module_inject/containers/bert.py``, ``distil_bert.py``,
+``clip.py`` — which rewrites HF modules with fused kernels. Here the same
+architectures are framework-owned functional models (the decoder-only
+counterpart is ``models/transformer.py``): stacked per-layer leaves scanned
+with ``lax.scan``, TP/FSDP placement declared via ``sharding_rules``, and
+attention routed through the same ``models/layers.attention`` seam (flash
+kernel on TPU, XLA oracle elsewhere).
+
+The vision tower's patchify is the conv-as-matmul formulation — a stride-p
+conv over non-overlapping patches IS a reshape+matmul, which XLA tiles onto
+the MXU far better than a tiny-window conv.
+"""
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .layers import attention, layer_norm
+
+Params = Dict[str, Any]
+
+
+def _act(name: str):
+    if name == "quick_gelu":            # CLIP: x * sigmoid(1.702 x)
+        return lambda x: x * jax.nn.sigmoid(1.702 * x)
+    if name == "gelu_exact":
+        return lambda x: jax.nn.gelu(x, approximate=False)
+    if name == "gelu":
+        return jax.nn.gelu
+    if name == "relu":
+        return jax.nn.relu
+    raise ValueError(f"unsupported encoder activation {name!r}")
+
+
+@dataclasses.dataclass
+class EncoderConfig:
+    """Config for one transformer tower (text or vision)."""
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 512
+    type_vocab_size: int = 2          # 0 => no token-type embeddings
+    layer_norm_eps: float = 1e-12
+    activation: str = "gelu_exact"    # HF bert "gelu" is the erf form
+    norm_position: str = "post"       # bert/distilbert: post-LN; clip: pre-LN
+    causal: bool = False              # clip text tower attends causally
+    dtype: str = "float32"
+    # vision tower (0 => text tower)
+    image_size: int = 0
+    patch_size: int = 0
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+# ======================================================================
+# shared tower
+# ======================================================================
+def _dense(rng, shape, std=0.02):
+    return jax.random.normal(rng, shape, jnp.float32) * std
+
+
+def _ln_params(d):
+    return {"scale": jnp.ones((d,), jnp.float32),
+            "bias": jnp.zeros((d,), jnp.float32)}
+
+
+def tower_layer_params(cfg: EncoderConfig, rng) -> Params:
+    d, f = cfg.hidden_size, cfg.intermediate_size
+    ks = iter(jax.random.split(rng, 8))
+    return {
+        "attn": {"wq": _dense(next(ks), (d, d)), "bq": jnp.zeros((d,)),
+                 "wk": _dense(next(ks), (d, d)), "bk": jnp.zeros((d,)),
+                 "wv": _dense(next(ks), (d, d)), "bv": jnp.zeros((d,)),
+                 "wo": _dense(next(ks), (d, d)), "bo": jnp.zeros((d,))},
+        "attn_norm": _ln_params(d),
+        "mlp": {"fc1": _dense(next(ks), (d, f)), "b1": jnp.zeros((f,)),
+                "fc2": _dense(next(ks), (f, d)), "b2": jnp.zeros((d,))},
+        "mlp_norm": _ln_params(d),
+    }
+
+
+def tower_forward(cfg: EncoderConfig, layers: Params, x: jnp.ndarray,
+                  mask: Optional[jnp.ndarray]) -> jnp.ndarray:
+    """Scan the stacked encoder layers over ``x [B,S,D]``.
+
+    ``mask [B,S]``: 1 for valid tokens. Padding isolation rides the flash
+    kernel's segment-id masking (pads form their own segment, so valid
+    tokens never attend to them); outputs at pad rows are garbage the
+    caller must ignore — exactly the HF contract.
+    """
+    act = _act(cfg.activation)
+    eps = cfg.layer_norm_eps
+    seg = mask.astype(jnp.int32) if mask is not None else None
+    b, s, d = x.shape
+
+    def attn_sub(p, h):
+        q = (jnp.einsum("bsd,dq->bsq", h, p["wq"])
+             + p["bq"].astype(h.dtype))
+        k = (jnp.einsum("bsd,dk->bsk", h, p["wk"])
+             + p["bk"].astype(h.dtype))
+        v = (jnp.einsum("bsd,dk->bsk", h, p["wv"])
+             + p["bv"].astype(h.dtype))
+        q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(b, s, cfg.num_heads, cfg.head_dim)
+        v = v.reshape(b, s, cfg.num_heads, cfg.head_dim)
+        o = attention(q, k, v, causal=cfg.causal, segment_ids=seg)
+        o = o.reshape(b, s, d)
+        return jnp.einsum("bsq,qd->bsd", o, p["wo"]) + p["bo"].astype(h.dtype)
+
+    def mlp_sub(p, h):
+        h = act(jnp.einsum("bsd,df->bsf", h, p["fc1"])
+                + p["b1"].astype(h.dtype))
+        return jnp.einsum("bsf,fd->bsd", h, p["fc2"]) + p["b2"].astype(h.dtype)
+
+    def ln(h, p):
+        return layer_norm(h, p["scale"], p["bias"], eps)
+
+    def layer(h, p):
+        if cfg.norm_position == "post":       # bert: LN(x + sub(x))
+            h = ln(h + attn_sub(p["attn"], h), p["attn_norm"])
+            h = ln(h + mlp_sub(p["mlp"], h), p["mlp_norm"])
+        else:                                  # clip/vit: x + sub(LN(x))
+            h = h + attn_sub(p["attn"], ln(h, p["attn_norm"]))
+            h = h + mlp_sub(p["mlp"], ln(h, p["mlp_norm"]))
+        return h, None
+
+    x, _ = jax.lax.scan(layer, x, layers)
+    return x
+
+
+def _tower_sharding(names, s: str, pre: Tuple) -> Optional[Tuple]:
+    if s.endswith(("wq", "wk", "wv", "fc1")):
+        return pre + ("fsdp", "model")
+    if s.endswith(("wo", "fc2")):
+        return pre + ("model", "fsdp")
+    return (pre or None) if pre else None
+
+
+# ======================================================================
+# BERT family
+# ======================================================================
+class BertModel:
+    """BERT / DistilBERT masked-LM model (engine protocol: ``init_params``,
+    ``loss``, ``sharding_rules``; serving surface: :meth:`apply`).
+
+    Reference parity targets: ``module_inject/containers/bert.py`` (layer
+    rewrite) and ``distil_bert.py``; ingestion + logits parity live in
+    ``checkpoint/hf.load_hf_encoder_checkpoint``.
+    """
+
+    def __init__(self, config: EncoderConfig, seed: int = 0,
+                 tie_mlm_decoder: bool = True):
+        self.config = config
+        self.seed = seed
+        self.tie_mlm_decoder = tie_mlm_decoder
+
+    def init_params(self, rng: Optional[jax.Array] = None) -> Params:
+        cfg = self.config
+        rng = rng if rng is not None else jax.random.PRNGKey(self.seed)
+        ks = iter(jax.random.split(rng, 16))
+        d = cfg.hidden_size
+        params: Params = {
+            "embed": {"word": _dense(next(ks), (cfg.vocab_size, d)),
+                      "pos": _dense(next(ks), (cfg.max_seq_len, d))},
+            "embed_norm": _ln_params(d),
+            "layers": jax.vmap(lambda k: tower_layer_params(cfg, k))(
+                jax.random.split(next(ks), cfg.num_layers)),
+            "mlm": {"dense": _dense(next(ks), (d, d)),
+                    "bias_d": jnp.zeros((d,)),
+                    "norm": _ln_params(d),
+                    "decoder_bias": jnp.zeros((cfg.vocab_size,))},
+            "pooler": {"w": _dense(next(ks), (d, d)), "b": jnp.zeros((d,))},
+        }
+        if cfg.type_vocab_size > 0:
+            params["embed"]["type"] = _dense(next(ks),
+                                             (cfg.type_vocab_size, d))
+        if not self.tie_mlm_decoder:
+            params["mlm"]["decoder"] = _dense(next(ks), (d, cfg.vocab_size))
+        return params
+
+    # ---------------------------------------------------------------- forward
+    def encode(self, params: Params, input_ids: jnp.ndarray,
+               attention_mask: Optional[jnp.ndarray] = None,
+               token_type_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        cfg = self.config
+        b, s = input_ids.shape
+        x = params["embed"]["word"][input_ids]
+        x = x + params["embed"]["pos"][jnp.arange(s)][None]
+        if cfg.type_vocab_size > 0:
+            tt = (token_type_ids if token_type_ids is not None
+                  else jnp.zeros((b, s), jnp.int32))
+            x = x + params["embed"]["type"][tt]
+        x = layer_norm(x, params["embed_norm"]["scale"],
+                       params["embed_norm"]["bias"], cfg.layer_norm_eps)
+        x = x.astype(jnp.dtype(cfg.dtype))
+        return tower_forward(cfg, params["layers"], x, attention_mask)
+
+    def apply(self, params: Params, input_ids: jnp.ndarray,
+              attention_mask: Optional[jnp.ndarray] = None,
+              token_type_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        """Masked-LM logits [B,S,V]."""
+        cfg = self.config
+        h = self.encode(params, input_ids, attention_mask, token_type_ids)
+        m = params["mlm"]
+        h = jnp.einsum("bsd,de->bse", h, m["dense"]) + m["bias_d"]
+        h = _act(cfg.activation)(h)
+        h = layer_norm(h, m["norm"]["scale"], m["norm"]["bias"],
+                       cfg.layer_norm_eps)
+        dec = (params["embed"]["word"].T if self.tie_mlm_decoder
+               else m["decoder"])
+        return (jnp.einsum("bsd,dv->bsv", h, dec.astype(h.dtype))
+                + m["decoder_bias"]).astype(jnp.float32)
+
+    def pooled(self, params: Params, input_ids: jnp.ndarray,
+               attention_mask: Optional[jnp.ndarray] = None,
+               token_type_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        """[CLS] pooler output [B,D] (the classification head input)."""
+        h = self.encode(params, input_ids, attention_mask, token_type_ids)
+        p = params["pooler"]
+        return jnp.tanh(h[:, 0] @ p["w"] + p["b"])
+
+    # ------------------------------------------------------------------ loss
+    def loss(self, params: Params, batch: Dict[str, jnp.ndarray],
+             rng: Optional[jax.Array] = None, train: bool = True):
+        """Masked-LM cross-entropy: ``labels`` with -100 (HF) or any
+        negative value marking unmasked positions."""
+        logits = self.apply(params, batch["input_ids"],
+                            batch.get("attention_mask"),
+                            batch.get("token_type_ids"))
+        labels = batch["labels"]
+        mask = (labels >= 0).astype(jnp.float32)
+        labels = jnp.maximum(labels, 0)
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+        nll = (logz - gold) * mask
+        loss = nll.sum() / jnp.maximum(mask.sum(), 1.0)
+        return loss, {"mlm_loss": loss}
+
+    # -------------------------------------------------------------- sharding
+    def sharding_rules(self, path, shape) -> Optional[Tuple]:
+        names = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
+        s = "/".join(str(n) for n in names)
+        pre = (None,) if "layers" in names else ()
+        if s.endswith(("embed/word", "mlm/decoder")):
+            return ("model", "fsdp") if s.endswith("word") else ("fsdp",
+                                                                 "model")
+        return _tower_sharding(names, s, pre)
+
+
+# ======================================================================
+# CLIP
+# ======================================================================
+@dataclasses.dataclass
+class CLIPConfig:
+    text: EncoderConfig = dataclasses.field(default_factory=lambda:
+                                            EncoderConfig(
+                                                vocab_size=49408,
+                                                hidden_size=512,
+                                                intermediate_size=2048,
+                                                num_layers=12, num_heads=8,
+                                                max_seq_len=77,
+                                                type_vocab_size=0,
+                                                layer_norm_eps=1e-5,
+                                                activation="quick_gelu",
+                                                norm_position="pre",
+                                                causal=True))
+    vision: EncoderConfig = dataclasses.field(default_factory=lambda:
+                                              EncoderConfig(
+                                                  vocab_size=0,
+                                                  hidden_size=768,
+                                                  intermediate_size=3072,
+                                                  num_layers=12,
+                                                  num_heads=12,
+                                                  type_vocab_size=0,
+                                                  layer_norm_eps=1e-5,
+                                                  activation="quick_gelu",
+                                                  norm_position="pre",
+                                                  image_size=224,
+                                                  patch_size=32))
+    projection_dim: int = 512
+    eos_token_id: int = 49407
+    logit_scale_init: float = 2.6592
+
+
+class CLIPModel:
+    """CLIP dual-tower model (reference ``module_inject/containers/clip.py``
+    rewrites the HF towers; here both towers are native).
+
+    ``apply_text`` / ``apply_image`` give the projected, L2-normalized
+    embeddings; :meth:`loss` is the symmetric contrastive objective.
+    """
+
+    def __init__(self, config: Optional[CLIPConfig] = None, seed: int = 0):
+        self.config = config or CLIPConfig()
+        self.seed = seed
+
+    def init_params(self, rng: Optional[jax.Array] = None) -> Params:
+        cfg = self.config
+        rng = rng if rng is not None else jax.random.PRNGKey(self.seed)
+        ks = iter(jax.random.split(rng, 16))
+        t, v = cfg.text, cfg.vision
+        patch_in = 3 * v.patch_size * v.patch_size
+        return {
+            "text": {
+                "embed": {"word": _dense(next(ks), (t.vocab_size,
+                                                    t.hidden_size)),
+                          "pos": _dense(next(ks), (t.max_seq_len,
+                                                   t.hidden_size))},
+                "layers": jax.vmap(lambda k: tower_layer_params(t, k))(
+                    jax.random.split(next(ks), t.num_layers)),
+                "final_norm": _ln_params(t.hidden_size),
+            },
+            "vision": {
+                "class_embed": _dense(next(ks), (v.hidden_size,)),
+                "patch_embed": _dense(next(ks), (patch_in, v.hidden_size)),
+                "pos_embed": _dense(next(ks), (v.num_patches + 1,
+                                               v.hidden_size)),
+                "pre_norm": _ln_params(v.hidden_size),
+                "layers": jax.vmap(lambda k: tower_layer_params(v, k))(
+                    jax.random.split(next(ks), v.num_layers)),
+                "post_norm": _ln_params(v.hidden_size),
+            },
+            "text_projection": _dense(next(ks), (t.hidden_size,
+                                                 cfg.projection_dim)),
+            "visual_projection": _dense(next(ks), (v.hidden_size,
+                                                   cfg.projection_dim)),
+            "logit_scale": jnp.asarray(cfg.logit_scale_init, jnp.float32),
+        }
+
+    # ---------------------------------------------------------------- towers
+    def apply_text(self, params: Params, input_ids: jnp.ndarray
+                   ) -> jnp.ndarray:
+        """Projected text embeddings [B, proj] (NOT normalized — HF
+        get_text_features contract)."""
+        cfg = self.config.text
+        p = params["text"]
+        b, s = input_ids.shape
+        x = p["embed"]["word"][input_ids] + p["embed"]["pos"][
+            jnp.arange(s)][None]
+        x = tower_forward(cfg, p["layers"], x, None)
+        x = layer_norm(x, p["final_norm"]["scale"], p["final_norm"]["bias"],
+                       cfg.layer_norm_eps)
+        # pool at the (first) EOS token position
+        is_eos = (input_ids == self.config.eos_token_id)
+        eos_pos = jnp.argmax(is_eos, axis=1)
+        # prompts without an explicit eos fall back to the last token
+        eos_pos = jnp.where(is_eos.any(axis=1), eos_pos, s - 1)
+        pooled = jnp.take_along_axis(x, eos_pos[:, None, None], axis=1)[:, 0]
+        return pooled @ params["text_projection"]
+
+    def apply_image(self, params: Params, pixel_values: jnp.ndarray
+                    ) -> jnp.ndarray:
+        """Projected image embeddings [B, proj]. ``pixel_values``:
+        [B, 3, H, W] (the HF processor layout)."""
+        cfg = self.config.vision
+        p = params["vision"]
+        b = pixel_values.shape[0]
+        ps, d = cfg.patch_size, cfg.hidden_size
+        hp = cfg.image_size // ps
+        # conv-as-matmul patchify: [B,3,H,W] → [B, N, p·p·3] @ [p·p·3, D]
+        x = jnp.transpose(pixel_values, (0, 2, 3, 1))        # B,H,W,C
+        x = x.reshape(b, hp, ps, hp, ps, 3)
+        x = jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(b, hp * hp, -1)
+        x = x @ p["patch_embed"]
+        cls = jnp.broadcast_to(p["class_embed"], (b, 1, d))
+        x = jnp.concatenate([cls, x], axis=1) + p["pos_embed"][None]
+        x = layer_norm(x, p["pre_norm"]["scale"], p["pre_norm"]["bias"],
+                       cfg.layer_norm_eps)
+        x = tower_forward(cfg, p["layers"], x, None)
+        pooled = layer_norm(x[:, 0], p["post_norm"]["scale"],
+                            p["post_norm"]["bias"], cfg.layer_norm_eps)
+        return pooled @ params["visual_projection"]
+
+    def apply(self, params: Params, input_ids: jnp.ndarray,
+              pixel_values: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """(logits_per_text [Bt,Bi], logits_per_image [Bi,Bt])."""
+        te = self.apply_text(params, input_ids)
+        ie = self.apply_image(params, pixel_values)
+        te = te / jnp.linalg.norm(te, axis=-1, keepdims=True)
+        ie = ie / jnp.linalg.norm(ie, axis=-1, keepdims=True)
+        scale = jnp.exp(params["logit_scale"])
+        lt = scale * te @ ie.T
+        return lt, lt.T
+
+    # ------------------------------------------------------------------ loss
+    def loss(self, params: Params, batch: Dict[str, jnp.ndarray],
+             rng: Optional[jax.Array] = None, train: bool = True):
+        """Symmetric InfoNCE over in-batch pairs (the CLIP objective)."""
+        lt, li = self.apply(params, batch["input_ids"],
+                            batch["pixel_values"])
+        n = lt.shape[0]
+        labels = jnp.arange(n)
+
+        def xent(logits):
+            logz = jax.scipy.special.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(logits, labels[:, None],
+                                       axis=-1)[:, 0]
+            return (logz - gold).mean()
+
+        loss = 0.5 * (xent(lt) + xent(li))
+        return loss, {"clip_loss": loss}
+
+    # -------------------------------------------------------------- sharding
+    def sharding_rules(self, path, shape) -> Optional[Tuple]:
+        names = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
+        s = "/".join(str(n) for n in names)
+        pre = (None,) if "layers" in names else ()
+        if s.endswith("embed/word"):
+            return ("model", "fsdp")
+        if s.endswith(("text_projection", "visual_projection")):
+            return ("fsdp", "model")
+        return _tower_sharding(names, s, pre)

@@ -214,7 +214,6 @@ class Engine:
         # ---------------------------------------------------------- placement
         stage = self.config.zero.stage
         self.zero_stage = stage
-        self._sharding_rules = sharding_rules
         self.param_shardings = zero_lib.tree_param_shardings(
             params, self.topology, stage, extra_rules=sharding_rules)
         # Stage >= 2: gradients (and the fp32 grad accumulator the scan

@@ -103,10 +103,15 @@ class MultiHostCPUAdam:
             shards: Dict[str, np.ndarray] = {}
             for s in leaf.addressable_shards:
                 k = _idx_key(s.index)
-                if k in shards:
+                need_store = k not in shards
+                # the norm counts every replica-0 shard even when another
+                # local replica already filled the store — skipping it
+                # would silently drop the block from the global norm
+                if not need_store and s.replica_id != 0:
                     continue
                 g = np.asarray(s.data, dtype=np.float32) / scale
-                shards[k] = g
+                if need_store:
+                    shards[k] = g
                 if s.replica_id == 0:
                     # each logical block counted exactly once globally
                     sq += float((g * g).sum())
@@ -200,7 +205,10 @@ class MultiHostCPUAdam:
                 for s in leaf.addressable_shards:
                     k = _idx_key(s.index)
                     if k not in shards:
-                        shards[k] = np.array(s.data, dtype=np.float32)
+                        a = np.array(s.data)   # writable copy
+                        if np.issubdtype(a.dtype, np.floating):
+                            a = a.astype(np.float32)
+                        shards[k] = a          # ints keep their dtype
                 store[i] = shards
 
         pull(master_tree, self.master)
