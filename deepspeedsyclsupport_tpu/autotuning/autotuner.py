@@ -1,16 +1,23 @@
-"""Autotuner — measured search over engine configs.
+"""Autotuner — model-pruned, measured search over engine configs.
 
 Analog of ``deepspeed/autotuning/`` (2717 LoC): the reference forks whole
-training jobs per experiment, scrapes metric files, and model-prunes the space
-(``autotuner.py`` ``tune_space`` / ``model_based_tuning``). Under JAX an
-"experiment" is cheap — build an Engine in-process, jit once, time a few steps —
-so the same search collapses to a loop:
+training jobs per experiment, scrapes metric files, and model-prunes the
+space (``autotuner.py`` ``tune_space`` / ``model_based_tuning`` /
+``max_train_micro_batch_size``). Under JAX an "experiment" is cheap — build
+an Engine in-process, jit once, time a few steps — so the same search
+collapses to a loop over the same dimensions the reference explores:
 
-* space: micro-batch size × ZeRO stage (× user extras), fastest-first ordering.
-* metric: measured samples/sec (or tokens/sec) over ``steps`` after warmup —
-  the same `throughput` metric the reference optimizes.
-* OOM-safe: a failing candidate (XLA OOM / bad config) scores -inf and the
-  search continues, mirroring the reference's failed-experiment handling.
+* space: micro-batch size × ZeRO stage × activation-checkpointing (remat)
+  × optimizer offload (× user extras), with per-dimension overrides.
+* model-based pruning: candidates whose PREDICTED device memory
+  (``runtime/zero.predict_memory_per_device`` — the numeric form of the
+  stage partition math) exceeds the HBM budget are skipped without ever
+  compiling, mirroring the reference's memory-model experiment pruning.
+* metric: measured samples/sec over ``steps`` after warmup — the
+  ``throughput`` metric the reference optimizes.
+* OOM-safe: a candidate that still fails in practice (XLA OOM / invalid
+  combo) scores -inf and the search continues, mirroring the reference's
+  failed-experiment handling.
 """
 import itertools
 import time
@@ -26,10 +33,16 @@ class TuneResult:
     best_throughput: float  # samples/sec
     trials: List[Dict[str, Any]] = field(default_factory=list)
 
+    @property
+    def pruned(self) -> List[Dict[str, Any]]:
+        return [t for t in self.trials if t.get("pruned")]
+
 
 DEFAULT_SPACE = {
     "train_micro_batch_size_per_gpu": [1, 2, 4, 8, 16],
     "zero_optimization.stage": [0, 1, 2, 3],
+    "activation_checkpointing.partition_activations": [False, True],
+    "zero_optimization.offload_optimizer.device": ["none", "cpu"],
 }
 
 
@@ -45,33 +58,122 @@ class Autotuner:
     def __init__(self, model, base_config: Dict[str, Any],
                  make_batch: Callable[[int], Any],
                  space: Optional[Dict[str, Sequence]] = None,
-                 steps: int = 3, warmup: int = 1):
-        """``make_batch(global_batch_size) -> batch`` supplies data per trial."""
+                 steps: int = 3, warmup: int = 1,
+                 hbm_bytes: Optional[float] = None,
+                 seq_len: Optional[int] = None):
+        """``make_batch(global_batch_size) -> batch`` supplies data per
+        trial. ``hbm_bytes`` enables model-based pruning against a device
+        memory budget (None: probe the accelerator, 0/failed probe: no
+        pruning). ``seq_len`` feeds the activation-memory model (defaults
+        to the model config's ``max_seq_len`` when available)."""
         self.model = model
         self.base_config = base_config
         self.make_batch = make_batch
         self.space = space or DEFAULT_SPACE
         self.steps = steps
         self.warmup = warmup
+        if hbm_bytes is None:
+            hbm_bytes = self._probe_hbm()
+        self.hbm_bytes = hbm_bytes or 0
+        mcfg = getattr(model, "config", None)
+        self.seq_len = seq_len or getattr(mcfg, "max_seq_len", 0)
+        self._n_params = self._count_params()
 
+    # ------------------------------------------------------------ memory model
+    def _probe_hbm(self) -> float:
+        try:
+            import jax
+
+            stats = jax.devices()[0].memory_stats() or {}
+            return float(stats.get("bytes_limit", 0))
+        except Exception:
+            return 0
+
+    def _count_params(self) -> int:
+        import jax
+        import numpy as np
+
+        if not hasattr(self.model, "init_params"):
+            return 0
+        shapes = jax.eval_shape(self.model.init_params)
+        return int(sum(np.prod(l.shape)
+                       for l in jax.tree_util.tree_leaves(shapes)))
+
+    def _effective(self, label: Dict[str, Any], dotted: str, default):
+        """Trial value for a dimension: the label wins, else whatever the
+        base config pins, else the default — so dimensions FIXED in
+        base_config are modeled as configured, not as their defaults."""
+        if dotted in label:
+            return label[dotted]
+        d: Any = self.base_config
+        for p in dotted.split("."):
+            if not isinstance(d, dict) or p not in d:
+                return default
+            d = d[p]
+        return d
+
+    def _predict_bytes(self, label: Dict[str, Any]) -> float:
+        """Device-memory prediction for one candidate (0 = unknown)."""
+        from ..runtime.zero import predict_memory_per_device
+
+        if not self._n_params:
+            return 0
+        import jax
+
+        mcfg = getattr(self.model, "config", None)
+        hidden = getattr(mcfg, "hidden_size", 0)
+        layers = getattr(mcfg, "num_layers", 1)
+        mbs = int(self._effective(label, "train_micro_batch_size_per_gpu",
+                                  1))
+        stage = int(self._effective(label, "zero_optimization.stage", 0))
+        remat = bool(self._effective(
+            label, "activation_checkpointing.partition_activations", False))
+        offload = self._effective(
+            label, "zero_optimization.offload_optimizer.device",
+            "none") == "cpu"
+        # ~16 residual-stream-sized tensors live per layer without remat
+        # (qkv, scores-free flash, mlp intermediates, residuals)
+        act = (mbs * self.seq_len * hidden * 4 * 16 * layers
+               if hidden and self.seq_len else 0.0)
+        fsdp = jax.device_count() if stage >= 1 else 1
+        return predict_memory_per_device(
+            self._n_params, fsdp, stage, offload=offload,
+            activation_bytes=act, remat=remat, num_layers=layers)
+
+    # ------------------------------------------------------------------ search
     def tune(self) -> TuneResult:
         keys = list(self.space)
         trials = []
         best = (None, float("-inf"))
         for combo in itertools.product(*(self.space[k] for k in keys)):
             cfg = _deepcopy_config(self.base_config)
-            for k, v in zip(keys, combo):
-                _set_nested(cfg, k, v)
             label = dict(zip(keys, combo))
+            for k, v in zip(keys, combo):
+                # every dimension is written explicitly — "device": "none"
+                # must CLEAR an offload section the base config carries,
+                # and writing the leaf key preserves sibling settings
+                _set_nested(cfg, k, v)
+            pred = self._predict_bytes(label)
+            if self.hbm_bytes and pred > self.hbm_bytes:
+                trials.append({**label, "throughput": float("-inf"),
+                               "pruned": True,
+                               "predicted_bytes": pred})
+                logger.info("autotune: pruned %s (predicted %.2f GB > "
+                            "budget %.2f GB)", label, pred / 1e9,
+                            self.hbm_bytes / 1e9)
+                continue
             tput = self._measure(cfg, label)
-            trials.append({**label, "throughput": tput})
+            trials.append({**label, "throughput": tput,
+                           "predicted_bytes": pred})
             if tput > best[1]:
                 best = (cfg, tput)
         if best[0] is None:
             raise RuntimeError("no autotuning candidate succeeded")
         result = TuneResult(best[0], best[1], trials)
         log_dist(f"autotune: best {best[1]:.1f} samples/s with "
-                 f"{ {k: _get_nested(best[0], k) for k in keys} }")
+                 f"{ {k: _get_nested(best[0], k) for k in keys} } "
+                 f"({len(result.pruned)} candidates pruned by the memory "
+                 f"model, {len(trials)} trials)")
         return result
 
     # ------------------------------------------------------------------ trial
@@ -112,3 +214,4 @@ def _get_nested(cfg: Dict, dotted: str):
     for p in dotted.split("."):
         d = d[p]
     return d
+

@@ -276,19 +276,27 @@ class HFCheckpointSource:
 
     def resolve(self, name: str) -> Optional[str]:
         """Checkpoint name variants: some exports carry/drop the top-level
-        module prefix (``transformer.``/``model.``/``gpt_neox.``). The
-        direction is fixed per checkpoint (detected at index time): a
-        prefixed checkpoint only ever gains the prefix on unprefixed
-        lookups; an unprefixed one only ever strips it — never both, so a
-        wrong family map fails loudly instead of quietly mis-loading."""
+        module prefix (``transformer.``/``model.``/``bert.``/...). The
+        resolution direction is constrained per checkpoint (detected at
+        index time): a prefixed checkpoint only gains its prefix on
+        unprefixed lookups (plus the nested-module strip that reveals that
+        same prefix); an unprefixed one only strips — so a wrong family
+        map fails loudly instead of quietly mis-loading."""
         if name in self._name_to_file:
             return name
-        # strip one leading module level (encoder-only exports drop the
+        # Strip one leading module level (encoder-only exports drop the
         # outermost module: 'distilbert.transformer.layer...' is stored as
-        # 'transformer.layer...'); exact matches always win above
+        # 'transformer.layer...', 'distilbert.embeddings...' as
+        # 'embeddings...'). The one strip that stays FORBIDDEN is removing
+        # the checkpoint's own detected prefix — on a P-prefixed
+        # checkpoint, resolving a missed 'P.x' lookup to an unrelated
+        # unprefixed 'x' is exactly the quiet family-map mis-load this
+        # detection exists to prevent.
         for pre in _MODULE_PREFIXES:
-            if name.startswith(pre) and name[len(pre):] in self._name_to_file:
-                return name[len(pre):]
+            if pre != self._ckpt_prefix and name.startswith(pre):
+                stripped = name[len(pre):]
+                if stripped in self._name_to_file:
+                    return stripped
         if (self._ckpt_prefix is not None
                 and not name.startswith(self._ckpt_prefix)):
             cand = self._ckpt_prefix + name
@@ -996,8 +1004,13 @@ def load_hf_encoder_checkpoint(path: str, dtype: Any = None,
         if tie:
             top = {k: v for k, v in top.items() if k != ("mlm", "decoder")}
     else:
-        model = BertModel(cfg)
+        # an untied MLM decoder ships as its own tensor; tied exports omit
+        # it (safetensors refuses shared tensors)
+        tie = "cls.predictions.decoder.weight" not in src
+        model = BertModel(cfg, tie_mlm_decoder=tie)
         top, layer = _bert_maps(cfg)
+        if not tie:
+            top[("mlm", "decoder")] = ("cls.predictions.decoder.weight", _t)
     model.hf_config = hf_cfg
     params = model.init_params()
 

@@ -6,31 +6,49 @@ techniques applied to matching parameter groups. The reference rewrites torch
 modules in place; here compression is a pure tree→tree transform over the
 params pytree, matched by leaf path (the same module-name globbing semantics).
 
-Supported (round 1): ``weight_quantization`` (post-training, via
-``quantize.fake_quant``) and ``sparse_pruning`` (magnitude). Structured head/
-row pruning and layer reduction are config-validated but deferred.
+Techniques: ``weight_quantization`` (post-training, via
+``quantize.fake_quant``), ``sparse_pruning`` (unstructured magnitude),
+``row_pruning`` / ``channel_pruning`` (structured output/input-dim masking,
+reference ``basic_layer.LinearLayer_Compress`` row/channel masks),
+``head_pruning`` (whole attention heads by output-projection importance,
+reference head-mask path), and ``layer_reduction`` (student keeps a chosen
+subset of teacher layers — shape-CHANGING, see :func:`apply_layer_reduction`).
+
+Orientation note: torch ``nn.Linear`` stores ``[out, in]``; our einsums
+contract ``[in, out]``. The reference's "row pruning" removes OUTPUT rows,
+which here is the LAST axis; "channel pruning" removes input channels — our
+second-to-last axis.
 """
 import fnmatch
-from typing import Any, Dict
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from .quantize import fake_quant
-from ..utils.logging import logger
+from ..utils.logging import log_dist, logger
+
+
+def _groups(section: Dict, param_key: str, default, cast) -> List[Dict]:
+    out = []
+    for g in map(dict, dict(section.get("different_groups", {})).values()):
+        p = dict(g.get("params", {}))
+        out.append({param_key: cast(p.get(param_key, default)),
+                    "modules": list(g.get("modules", ["*"]))})
+    return out or [{param_key: default, "modules": ["*"]}]
 
 
 def get_compression_config(cfg: Dict[str, Any]) -> Dict[str, Any]:
     """Extract + default the ``compression_training`` section (reference
     ``deepspeed/compression/config.py``)."""
     c = dict(cfg.get("compression_training", {}))
-    out = {}
+    out: Dict[str, Any] = {}
     wq = dict(c.get("weight_quantization", {}))
     if wq:
         shared = dict(wq.get("shared_parameters", {}))
         out["weight_quantization"] = {
             "enabled": bool(shared.get("enabled", True)),
-            "groups": [  # per-group settings, like the reference
+            "groups": [
                 {"bits": int(dict(g.get("params", {})).get("target_bits", 8)),
                  "modules": list(g.get("modules", ["*"]))}
                 for g in map(dict,
@@ -42,37 +60,84 @@ def get_compression_config(cfg: Dict[str, Any]) -> Dict[str, Any]:
         shared = dict(sp.get("shared_parameters", {}))
         out["sparse_pruning"] = {
             "enabled": bool(shared.get("enabled", True)),
-            "groups": [
-                {"density": float(dict(g.get("params", {})).get(
-                    "dense_ratio", 0.5)),
-                 "modules": list(g.get("modules", ["*"]))}
-                for g in map(dict,
-                             dict(sp.get("different_groups", {})).values())
-            ] or [{"density": 0.5, "modules": ["*"]}],
+            "groups": _groups(sp, "dense_ratio", 0.5, float),
         }
-    for k in ("row_pruning", "head_pruning", "channel_pruning",
-              "layer_reduction"):
-        if c.get(k, {}) and dict(c[k]).get("shared_parameters",
-                                           {}).get("enabled", False):
-            logger.warning("compression technique %r not yet implemented on "
-                           "TPU build; ignored", k)
+    rp = dict(c.get("row_pruning", {}))
+    if rp:
+        out["row_pruning"] = {
+            "enabled": bool(dict(rp.get("shared_parameters",
+                                        {})).get("enabled", True)),
+            "groups": _groups(rp, "dense_ratio", 0.5, float),
+        }
+    cp = dict(c.get("channel_pruning", {}))
+    if cp:
+        out["channel_pruning"] = {
+            "enabled": bool(dict(cp.get("shared_parameters",
+                                        {})).get("enabled", True)),
+            "groups": _groups(cp, "dense_ratio", 0.5, float),
+        }
+    hp = dict(c.get("head_pruning", {}))
+    if hp:
+        shared = dict(hp.get("shared_parameters", {}))
+        out["head_pruning"] = {
+            "enabled": bool(shared.get("enabled", True)),
+            "num_heads": int(shared.get("num_heads", 0)),
+            "groups": _groups(hp, "dense_ratio", 0.5, float),
+        }
+        if out["head_pruning"]["enabled"] and not out["head_pruning"]["num_heads"]:
+            raise ValueError("head_pruning needs shared_parameters.num_heads "
+                             "(the reference requires it too)")
+    lr = dict(c.get("layer_reduction", {}))
+    if lr and bool(lr.get("enabled", False)):
+        out["layer_reduction"] = {
+            "enabled": True,
+            "keep_number_layer": lr.get("keep_number_layer"),
+            "teacher_layer": list(lr.get("teacher_layer", [])),
+        }
     return out
 
 
-def _modules(section, default):
-    mods = []
-    for g in dict(section.get("different_groups", {})).values():
-        mods.extend(dict(g).get("modules", []))
-    return mods or default
+def _topk_mask(scores: jnp.ndarray, density: float) -> jnp.ndarray:
+    """Boolean keep-mask over the last axis of ``scores`` (top-k by value)."""
+    n = scores.shape[-1]
+    k = max(1, int(round(n * density)))
+    thresh = jnp.sort(scores, axis=-1)[..., -k][..., None]
+    return scores >= thresh
 
 
 def compress(params: Any, config: Dict[str, Any]) -> Any:
-    """Apply configured compression to matching leaves; returns a new tree
-    (reference ``init_compression`` + ``redundancy_clean`` collapsed: no module
-    surgery, just math on leaves)."""
+    """Apply configured shape-PRESERVING compression to matching leaves;
+    returns a new tree (reference ``init_compression``: masks, not surgery —
+    the shape-changing ``layer_reduction`` lives in
+    :func:`apply_layer_reduction`)."""
     cc = get_compression_config(config)
     if not cc:
         return params
+    if cc.get("layer_reduction", {}).get("enabled"):
+        logger.warning(
+            "layer_reduction is enabled but compress() is shape-preserving "
+            "— call compression.apply_layer_reduction(model_config, "
+            "params, config) to build the student")
+
+    # Head pruning derives ONE per-module keep mask from the attention
+    # OUTPUT projection (reference: the head mask lives on the output
+    # matrix) and applies it to wq/wk/wv/wo alike — per-matrix masks would
+    # keep disjoint head sets and zero the whole attention output.
+    head_masks: Dict[str, Any] = {}
+    hp = cc.get("head_pruning")
+    if hp and hp["enabled"]:
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+            name = "/".join(str(getattr(k, "key", getattr(k, "name", k)))
+                            for k in kp)
+            if not name.endswith("wo") or getattr(leaf, "ndim", 0) < 2:
+                continue
+            for g in hp["groups"]:
+                if _match(name, g["modules"]):
+                    mask = _head_keep_mask(leaf, hp["num_heads"],
+                                           g["dense_ratio"])
+                    if mask is not None:
+                        head_masks[name.rsplit("/", 1)[0]] = mask
+                    break
 
     def visit(path, leaf):
         if not hasattr(leaf, "dtype") or not jnp.issubdtype(leaf.dtype,
@@ -92,14 +157,116 @@ def compress(params: Any, config: Dict[str, Any]) -> Any:
         if sp and sp["enabled"]:
             for g in sp["groups"]:
                 if _match(name, g["modules"]):
-                    k = max(1, int(leaf.size * g["density"]))
+                    k = max(1, int(leaf.size * g["dense_ratio"]))
                     thresh = jnp.sort(jnp.abs(leaf).ravel())[-k]
                     leaf = jnp.where(jnp.abs(leaf) >= thresh, leaf,
                                      jnp.zeros_like(leaf))
                     break
+        rp = cc.get("row_pruning")
+        if rp and rp["enabled"]:
+            for g in rp["groups"]:
+                if _match(name, g["modules"]):
+                    # output-dim (last axis) structured mask by L1 norm
+                    imp = jnp.abs(leaf).sum(axis=-2)
+                    keep = _topk_mask(imp, g["dense_ratio"])
+                    leaf = leaf * keep[..., None, :].astype(leaf.dtype)
+                    break
+        cp = cc.get("channel_pruning")
+        if cp and cp["enabled"]:
+            for g in cp["groups"]:
+                if _match(name, g["modules"]):
+                    # input-dim (second-to-last axis) structured mask
+                    imp = jnp.abs(leaf).sum(axis=-1)
+                    keep = _topk_mask(imp, g["dense_ratio"])
+                    leaf = leaf * keep[..., :, None].astype(leaf.dtype)
+                    break
+        if head_masks:
+            parent, _, suffix = name.rpartition("/")
+            mask = head_masks.get(parent)
+            if mask is not None and suffix in ("wq", "wk", "wv", "wo"):
+                leaf = _apply_head_mask(
+                    name, leaf, mask, hp["num_heads"],
+                    axis=-2 if suffix == "wo" else -1)
         return leaf
 
     return jax.tree_util.tree_map_with_path(visit, params)
+
+
+def _head_keep_mask(wo: jnp.ndarray, num_heads: int,
+                    density: float) -> Optional[jnp.ndarray]:
+    """Per-layer keep mask from the output projection ``[..., H·hd, d]``:
+    stacked leaves ``[L, H·hd, d]`` give an independent ``[L, H]`` mask per
+    layer (a head can matter in layer 0 and be dead in layer 11)."""
+    h_dim = wo.shape[-2]
+    if h_dim % num_heads:
+        logger.warning("head_pruning: wo dim %d not divisible by num_heads "
+                       "%d; module skipped", h_dim, num_heads)
+        return None
+    hd = h_dim // num_heads
+    shaped = wo.reshape(wo.shape[:-2] + (num_heads, hd, wo.shape[-1]))
+    imp = jnp.abs(shaped).sum(axis=(-2, -1))     # [..., H]
+    return _topk_mask(imp, density)
+
+
+def _apply_head_mask(name: str, leaf: jnp.ndarray, keep: jnp.ndarray,
+                     num_heads: int, axis: int) -> jnp.ndarray:
+    """Zero the pruned heads' slices along ``axis`` (head-major blocks)."""
+    h_dim = leaf.shape[axis]
+    if h_dim % num_heads:
+        # GQA k/v projections have fewer kv heads than the q mask covers —
+        # the wo mask already zeroes those heads' contribution
+        logger.warning("head_pruning: %s dim %d not divisible by num_heads "
+                       "%d; left unmasked (wo mask still silences the "
+                       "pruned heads)", name, h_dim, num_heads)
+        return leaf
+    hd = h_dim // num_heads
+    moved = jnp.moveaxis(leaf, axis, -1)
+    shaped = moved.reshape(moved.shape[:-1] + (num_heads, hd))
+    if keep.ndim == 2:        # stacked per-layer mask [L, H]
+        k = keep.reshape((keep.shape[0],)
+                         + (1,) * (shaped.ndim - 3)
+                         + (num_heads, 1))
+    else:
+        k = keep.reshape((1,) * (shaped.ndim - 2) + (num_heads, 1))
+    shaped = shaped * k.astype(leaf.dtype)
+    return jnp.moveaxis(shaped.reshape(moved.shape), -1, axis)
+
+
+def apply_layer_reduction(model_config, params: Any,
+                          config: Dict[str, Any]) -> Tuple[Any, Any]:
+    """Layer reduction (reference ``compression/compress.py``
+    ``student_initialization``): the student keeps ``teacher_layer``'s
+    layers (or the first ``keep_number_layer``), initialized from the
+    teacher — a shape-CHANGING transform, so it returns
+    ``(new_model_config, new_params)`` instead of masking in place.
+
+    Works on the stacked-layer layout (``params['layers']`` leaves lead
+    with the layer dim).
+    """
+    cc = get_compression_config(config).get("layer_reduction")
+    if not cc or not cc["enabled"]:
+        return model_config, params
+    n_layers = model_config.num_layers
+    keep = cc["teacher_layer"] or list(range(cc["keep_number_layer"] or
+                                             n_layers))
+    if cc["keep_number_layer"] and len(keep) != cc["keep_number_layer"]:
+        raise ValueError(
+            f"teacher_layer {keep} inconsistent with keep_number_layer "
+            f"{cc['keep_number_layer']}")
+    bad = [i for i in keep if not 0 <= i < n_layers]
+    if bad:
+        raise ValueError(f"teacher_layer indices {bad} out of range for "
+                         f"{n_layers} layers")
+    idx = jnp.asarray(keep, jnp.int32)
+    new_params = dict(params)
+    new_params["layers"] = jax.tree_util.tree_map(
+        lambda leaf: jnp.take(leaf, idx, axis=0), params["layers"])
+    import dataclasses
+
+    new_cfg = dataclasses.replace(model_config, num_layers=len(keep))
+    log_dist(f"layer_reduction: student keeps teacher layers {keep} "
+             f"({n_layers} → {len(keep)})")
+    return new_cfg, new_params
 
 
 def _match(name: str, patterns) -> bool:

@@ -77,6 +77,10 @@ class DSTpuInferenceConfig:
     seed: int = 0
     quant: ZeroInferenceQuantConfig = field(
         default_factory=ZeroInferenceQuantConfig)
+    # ZeRO-Inference's other half (reference README 20x claim: weight quant
+    # + KV offload): keep the decode KV cache in host memory, streaming
+    # per-layer slices through HBM — contexts larger than HBM allows
+    kv_offload: bool = False
 
     @classmethod
     def from_config(cls, config: Optional[Dict[str, Any]] = None, **kw

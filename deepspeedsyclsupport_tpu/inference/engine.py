@@ -117,6 +117,26 @@ class InferenceEngine:
         self._forward_fn = None
         self._generate_fns: Dict[Tuple, Callable] = {}
         self._rng = jax.random.PRNGKey(config.seed)
+        if config.kv_offload:
+            log_dist("ZeRO-Inference KV offload: decode cache pinned to "
+                     "host memory (per-layer slices stream through HBM)")
+
+    def _kv_to_host(self, cache):
+        """Annotate the decode cache as host-resident (ZeRO-Inference KV
+        offload — reference pairs weight quant with a CPU-side KV cache for
+        its 20x claim). Inside jit this is a memory-space annotation: XLA's
+        host-offloader streams each layer's k/v slice through HBM as the
+        layer scan consumes it, and the single-token write lands back in
+        host memory. The [*, *, *, kv_heads, *] spec keeps TP sharding."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        host = NamedSharding(self.topology.mesh,
+                             P(None, None, None, "model", None),
+                             memory_kind="pinned_host")
+        return type(cache)(jax.device_put(cache.k, host),
+                           jax.device_put(cache.v, host),
+                           cache.write_pos)
 
     def _quantize_weights(self, qcfg):
         """ZeRO-Inference: per-layer weights → int8 + blockwise scales
@@ -199,6 +219,15 @@ class InferenceEngine:
                 f"in the inference config")
         sp = SamplingParams(do_sample, float(temperature), int(top_k),
                             float(top_p))
+        if self.config.kv_offload:
+            # the model-side KV memory annotations (layers.attention_block)
+            # read the WORLD topology at trace time — pin it to THIS
+            # engine's mesh so an interleaved training engine / explicit
+            # topology= argument can't leave the two meshes diverged inside
+            # one jitted decode program
+            from ..comm.topology import set_world_topology
+
+            set_world_topology(self.topology)
         key = (s, int(max_new_tokens), sp, -1 if eos is None else int(eos))
         if key not in self._generate_fns:
             self._generate_fns[key] = jax.jit(partial(
@@ -226,9 +255,13 @@ class InferenceEngine:
         pad_id = self.config.pad_token_id
 
         cache = model.init_kv_cache(b, max_len, dtype=self.config.dtype)
+        if self.config.kv_offload:
+            cache = self._kv_to_host(cache)
         positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
         logits, cache = model.decode_step(params, cache, input_ids,
                                           positions=positions)
+        if self.config.kv_offload:
+            cache = self._kv_to_host(cache)
         last = jnp.take_along_axis(
             logits, (prompt_lens - 1)[:, None, None], axis=1)[:, 0]  # [B, V]
         rng, sub = jax.random.split(rng)
@@ -251,6 +284,11 @@ class InferenceEngine:
             logits, cache = model.decode_step(params, cache, tok[:, None],
                                               positions=pos, kv_mask=kv_mask,
                                               kv_positions=kv_pos)
+            if self.config.kv_offload:
+                # the carry must stay host-resident between decode steps —
+                # without this the first update migrates the whole cache
+                # back into HBM
+                cache = self._kv_to_host(cache)
             key, sub = jax.random.split(key)
             nxt = sample_token(logits[:, 0], sub, sp)
             if eos_id >= 0:

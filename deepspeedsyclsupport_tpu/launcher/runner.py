@@ -159,31 +159,105 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     world = build_world(args)
+    if args.dry_run:
+        for env in world:
+            cmd = _command(args, env)
+            print(f"[{env['host']}:{env['PROCESS_ID']}] "
+                  + " ".join(shlex.quote(c) for c in cmd))
+        return 0
+    procs = launch_world(args, world)
+    return supervise(procs)
+
+
+def launch_world(args, world: List[Dict[str, str]],
+                 popen=subprocess.Popen) -> List[subprocess.Popen]:
+    """Spawn every world entry (local exec or ssh fan-out — the reference's
+    ``runner.py:388`` pdsh/ssh launch). Each child starts in its OWN
+    process group so :func:`supervise` can reap the whole tree; ``popen``
+    is injectable for stub-executor tests."""
     procs: List[subprocess.Popen] = []
     for env in world:
         cmd = _command(args, env)
-        if args.dry_run:
-            print(f"[{env['host']}:{env['PROCESS_ID']}] "
-                  + " ".join(shlex.quote(c) for c in cmd))
-            continue
         full_env = {**os.environ, **{k: v for k, v in env.items()
                                      if k != "host"}}
-        procs.append(subprocess.Popen(cmd, env=full_env))
-    if args.dry_run:
-        return 0
+        procs.append(popen(cmd, env=full_env, start_new_session=True,
+                           preexec_fn=_child_preexec))
+    return procs
 
-    def _kill(signum, frame):  # reference launch.py:118 kills the tree
-        logger.warning("launcher: forwarding signal %d", signum)
-        for pr in procs:
-            pr.terminate()
 
-    signal.signal(signal.SIGINT, _kill)
-    signal.signal(signal.SIGTERM, _kill)
-    rc = 0
-    for pr in procs:
-        pr.wait()
-        rc = rc or pr.returncode
-    return rc
+def _child_preexec():  # pragma: no cover - runs in the forked child
+    """PR_SET_PDEATHSIG (Linux): if the LAUNCHER dies without running its
+    handlers (SIGKILL, crash between spawn and supervise), each direct
+    child still gets SIGTERM — new-session children would otherwise be
+    orphaned holding the chips. No-op off Linux."""
+    try:
+        import ctypes
+
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        libc.prctl(1, signal.SIGTERM)  # PR_SET_PDEATHSIG = 1
+    except Exception:
+        pass
+
+
+def _terminate_tree(procs: List[subprocess.Popen],
+                    grace: float = 5.0) -> None:
+    """SIGTERM every child's process GROUP, escalate to SIGKILL after the
+    grace window (reference ``launcher/launch.py:118``: terminate_process_
+    tree on SIGTERM — children of children must not survive the launcher).
+    """
+    import time
+
+    for p in procs:
+        if p.poll() is None:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+    deadline = time.monotonic() + grace
+    while time.monotonic() < deadline:
+        if all(p.poll() is not None for p in procs):
+            return
+        time.sleep(0.05)
+    for p in procs:
+        if p.poll() is None:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+
+def supervise(procs: List[subprocess.Popen], grace: float = 5.0,
+              poll_interval: float = 0.2) -> int:
+    """Fail-fast supervision: SIGINT/SIGTERM fan out to every process
+    group, and the first non-zero exit tears the world down (the
+    reference's any-rank-failure semantics, ``launch.py`` main loop)."""
+    import time
+
+    def _on_signal(signum, frame):
+        logger.warning("launcher: signal %d — terminating process trees",
+                       signum)
+        _terminate_tree(procs, grace)
+
+    prev_int = signal.signal(signal.SIGINT, _on_signal)
+    prev_term = signal.signal(signal.SIGTERM, _on_signal)
+    try:
+        while True:
+            codes = [p.poll() for p in procs]
+            bad = next((c for c in codes if c not in (None, 0)), None)
+            if bad is not None:
+                alive = sum(c is None for c in codes)
+                if alive:
+                    logger.error(
+                        "launcher: a process exited rc=%d; terminating the "
+                        "remaining %d (fail-fast)", bad, alive)
+                    _terminate_tree(procs, grace)
+                return bad
+            if all(c == 0 for c in codes):
+                return 0
+            time.sleep(poll_interval)
+    finally:
+        signal.signal(signal.SIGINT, prev_int)
+        signal.signal(signal.SIGTERM, prev_term)
 
 
 if __name__ == "__main__":  # pragma: no cover

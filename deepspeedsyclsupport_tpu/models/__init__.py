@@ -1,6 +1,8 @@
 """Built-in model families (framework-owned; see transformer.py docstring for how
 this replaces the reference's module_inject/model_implementations machinery)."""
 from .config import ModelConfig, PRESETS, get_config  # noqa: F401
+from .diffusion import (AutoencoderKL, UNet2DCondition,  # noqa: F401
+                        UNetConfig, VAEConfig)
 from .encoder import (BertModel, CLIPConfig, CLIPModel,  # noqa: F401
                       EncoderConfig)
 from .transformer import CausalLM, KVCache, build_model  # noqa: F401

@@ -1,0 +1,451 @@
+"""Diffusion model family: SD-style VAE (AutoencoderKL) and conditional UNet.
+
+Reference analog: the diffusion half of ``module_inject`` —
+``deepspeed/module_inject/containers/unet.py`` / ``vae.py`` replace the HF
+diffusers modules' attention and bias-adds with fused kernels
+(``csrc/spatial/csrc/opt_bias_add.cu``, diffusers attention in
+``ops/transformer/inference/diffusers_attention.py``). Here the
+architectures are framework-owned functional models, with the spatial
+bias-add family (``ops/spatial.py``) on the conv paths and attention routed
+through the shared :func:`models.layers.attention` seam.
+
+TPU notes: convs run NHWC (XLA's preferred TPU layout); spatial attention
+flattens H·W into a sequence so the flash kernel applies; GroupNorm runs in
+fp32 like the other norms.
+"""
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .layers import attention
+from ..ops.spatial import nhwc_bias_add
+
+Params = Dict[str, Any]
+
+
+# ======================================================================
+# primitives
+# ======================================================================
+def conv2d(x: jnp.ndarray, w: jnp.ndarray, b: Optional[jnp.ndarray] = None,
+           stride: int = 1, padding: int = 1) -> jnp.ndarray:
+    """NHWC conv with HWIO kernel (XLA tiles this onto the MXU)."""
+    y = jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride),
+        padding=[(padding, padding), (padding, padding)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    if b is not None:
+        y = nhwc_bias_add(y, b)
+    return y
+
+
+def group_norm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray,
+               groups: int = 32, eps: float = 1e-6) -> jnp.ndarray:
+    """GroupNorm over NHWC (diffusers convention), fp32 accumulation."""
+    b, h, w, c = x.shape
+    g = min(groups, c)
+    dtype = x.dtype
+    xf = x.astype(jnp.float32).reshape(b, h, w, g, c // g)
+    mean = xf.mean(axis=(1, 2, 4), keepdims=True)
+    var = ((xf - mean) ** 2).mean(axis=(1, 2, 4), keepdims=True)
+    xf = (xf - mean) * jax.lax.rsqrt(var + eps)
+    xf = xf.reshape(b, h, w, c)
+    return (xf * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(dtype)
+
+
+def timestep_embedding(t: jnp.ndarray, dim: int,
+                       max_period: float = 10000.0) -> jnp.ndarray:
+    """Sinusoidal timestep embedding [B, dim] (DDPM/diffusers convention)."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(max_period)
+                    * jnp.arange(half, dtype=jnp.float32) / half)
+    ang = t.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.concatenate([jnp.cos(ang), jnp.sin(ang)], axis=-1)
+
+
+def _conv_p(rng, kh, kw, cin, cout, std=None):
+    std = std if std is not None else 1.0 / np.sqrt(kh * kw * cin)
+    return {"w": jax.random.normal(rng, (kh, kw, cin, cout),
+                                   jnp.float32) * std,
+            "b": jnp.zeros((cout,), jnp.float32)}
+
+
+def _lin_p(rng, cin, cout, std=None):
+    std = std if std is not None else 1.0 / np.sqrt(cin)
+    return {"w": jax.random.normal(rng, (cin, cout), jnp.float32) * std,
+            "b": jnp.zeros((cout,), jnp.float32)}
+
+
+def _gn_p(c):
+    return {"scale": jnp.ones((c,), jnp.float32),
+            "bias": jnp.zeros((c,), jnp.float32)}
+
+
+def _lin(p, x):
+    return x @ p["w"] + p["b"].astype(x.dtype)
+
+
+# ======================================================================
+# blocks
+# ======================================================================
+def resnet_block_params(rng, cin, cout, temb_dim: int = 0) -> Params:
+    ks = iter(jax.random.split(rng, 4))
+    p = {"norm1": _gn_p(cin), "conv1": _conv_p(next(ks), 3, 3, cin, cout),
+         "norm2": _gn_p(cout), "conv2": _conv_p(next(ks), 3, 3, cout, cout)}
+    if temb_dim:
+        p["temb"] = _lin_p(next(ks), temb_dim, cout)
+    if cin != cout:
+        p["shortcut"] = _conv_p(next(ks), 1, 1, cin, cout)
+    return p
+
+
+def resnet_block(p: Params, x: jnp.ndarray,
+                 temb: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    h = jax.nn.silu(group_norm(x, **p["norm1"]))
+    h = conv2d(h, p["conv1"]["w"], p["conv1"]["b"])
+    if temb is not None and "temb" in p:
+        h = h + _lin(p["temb"], jax.nn.silu(temb))[:, None, None, :]
+    h = jax.nn.silu(group_norm(h, **p["norm2"]))
+    h = conv2d(h, p["conv2"]["w"], p["conv2"]["b"])
+    if "shortcut" in p:
+        x = conv2d(x, p["shortcut"]["w"], p["shortcut"]["b"], padding=0)
+    return x + h
+
+
+def attn_block_params(rng, c, ctx_dim: int = 0) -> Params:
+    ks = iter(jax.random.split(rng, 5))
+    kv_in = ctx_dim or c
+    return {"norm": _gn_p(c),
+            "q": _lin_p(next(ks), c, c), "k": _lin_p(next(ks), kv_in, c),
+            "v": _lin_p(next(ks), kv_in, c), "o": _lin_p(next(ks), c, c)}
+
+
+def spatial_attention(p: Params, x: jnp.ndarray,
+                      context: Optional[jnp.ndarray] = None,
+                      heads: int = 1) -> jnp.ndarray:
+    """Self- (or cross-) attention over flattened H·W positions — the role
+    of the reference's fused diffusers attention
+    (``ops/transformer/inference/diffusers_attention.py``). ``heads`` is
+    model config, NOT a param leaf (int leaves would break jax.grad)."""
+    b, hh, ww, c = x.shape
+    hd = c // heads
+    seq = group_norm(x, **p["norm"]).reshape(b, hh * ww, c)
+    ctx = seq if context is None else context.astype(seq.dtype)
+    q = _lin(p["q"], seq).reshape(b, hh * ww, heads, hd)
+    k = _lin(p["k"], ctx).reshape(b, ctx.shape[1], heads, hd)
+    v = _lin(p["v"], ctx).reshape(b, ctx.shape[1], heads, hd)
+    o = attention(q, k, v, causal=False).reshape(b, hh * ww, c)
+    return x + _lin(p["o"], o).reshape(b, hh, ww, c)
+
+
+# ======================================================================
+# VAE (AutoencoderKL)
+# ======================================================================
+@dataclasses.dataclass
+class VAEConfig:
+    in_channels: int = 3
+    latent_channels: int = 4
+    base_channels: int = 32
+    channel_mults: Tuple[int, ...] = (1, 2, 4)
+    layers_per_block: int = 1
+    scaling_factor: float = 0.18215   # SD latent scale
+    dtype: str = "float32"
+
+
+class AutoencoderKL:
+    """SD-style KL VAE (reference serving surface:
+    ``module_inject/containers/vae.py`` policy over diffusers
+    ``AutoencoderKL``). Engine protocol: ``init_params`` / ``loss``."""
+
+    def __init__(self, config: Optional[VAEConfig] = None, seed: int = 0):
+        self.config = config or VAEConfig()
+        self.seed = seed
+
+    def init_params(self, rng: Optional[jax.Array] = None) -> Params:
+        cfg = self.config
+        rng = rng if rng is not None else jax.random.PRNGKey(self.seed)
+        ks = iter(jax.random.split(rng, 64))
+        chans = [cfg.base_channels * m for m in cfg.channel_mults]
+        enc: Params = {"conv_in": _conv_p(next(ks), 3, 3, cfg.in_channels,
+                                          chans[0]),
+                       "down": []}
+        c = chans[0]
+        for i, co in enumerate(chans):
+            blk = {"res": [resnet_block_params(next(ks), c if j == 0 else co,
+                                               co)
+                           for j in range(cfg.layers_per_block)]}
+            if i < len(chans) - 1:
+                blk["down"] = _conv_p(next(ks), 3, 3, co, co)
+            enc["down"].append(blk)
+            c = co
+        enc["mid"] = {"res1": resnet_block_params(next(ks), c, c),
+                      "attn": attn_block_params(next(ks), c),
+                      "res2": resnet_block_params(next(ks), c, c)}
+        enc["norm_out"] = _gn_p(c)
+        enc["conv_out"] = _conv_p(next(ks), 3, 3, c,
+                                  2 * cfg.latent_channels)
+        dec: Params = {"conv_in": _conv_p(next(ks), 3, 3,
+                                          cfg.latent_channels, c),
+                       "mid": {"res1": resnet_block_params(next(ks), c, c),
+                               "attn": attn_block_params(next(ks), c),
+                               "res2": resnet_block_params(next(ks), c, c)},
+                       "up": []}
+        for i, co in enumerate(reversed(chans)):
+            blk = {"res": [resnet_block_params(next(ks), c if j == 0 else co,
+                                               co)
+                           for j in range(cfg.layers_per_block + 1)]}
+            if i < len(chans) - 1:
+                blk["up"] = _conv_p(next(ks), 3, 3, co, co)
+            dec["up"].append(blk)
+            c = co
+        dec["norm_out"] = _gn_p(c)
+        dec["conv_out"] = _conv_p(next(ks), 3, 3, c, cfg.in_channels)
+        return {"encoder": enc, "decoder": dec,
+                "quant_conv": _conv_p(next(ks), 1, 1,
+                                      2 * cfg.latent_channels,
+                                      2 * cfg.latent_channels),
+                "post_quant_conv": _conv_p(next(ks), 1, 1,
+                                           cfg.latent_channels,
+                                           cfg.latent_channels)}
+
+    # ---------------------------------------------------------------- encode
+    def encode(self, params: Params, x: jnp.ndarray
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """[B,H,W,3] → (mean, logvar) latents [B,H/2^d,W/2^d,Cl]."""
+        p = params["encoder"]
+        h = conv2d(x, p["conv_in"]["w"], p["conv_in"]["b"])
+        for blk in p["down"]:
+            for r in blk["res"]:
+                h = resnet_block(r, h)
+            if "down" in blk:
+                h = conv2d(h, blk["down"]["w"], blk["down"]["b"], stride=2)
+        m = p["mid"]
+        h = resnet_block(m["res1"], h)
+        h = spatial_attention(m["attn"], h)
+        h = resnet_block(m["res2"], h)
+        h = jax.nn.silu(group_norm(h, **p["norm_out"]))
+        h = conv2d(h, p["conv_out"]["w"], p["conv_out"]["b"])
+        h = conv2d(h, params["quant_conv"]["w"], params["quant_conv"]["b"],
+                   padding=0)
+        mean, logvar = jnp.split(h, 2, axis=-1)
+        return mean, jnp.clip(logvar, -30.0, 20.0)
+
+    def decode(self, params: Params, z: jnp.ndarray) -> jnp.ndarray:
+        p = params["decoder"]
+        h = conv2d(z, params["post_quant_conv"]["w"],
+                   params["post_quant_conv"]["b"], padding=0)
+        h = conv2d(h, p["conv_in"]["w"], p["conv_in"]["b"])
+        m = p["mid"]
+        h = resnet_block(m["res1"], h)
+        h = spatial_attention(m["attn"], h)
+        h = resnet_block(m["res2"], h)
+        for blk in p["up"]:
+            for r in blk["res"]:
+                h = resnet_block(r, h)
+            if "up" in blk:
+                b, hh, ww, c = h.shape
+                h = jax.image.resize(h, (b, hh * 2, ww * 2, c), "nearest")
+                h = conv2d(h, blk["up"]["w"], blk["up"]["b"])
+        h = jax.nn.silu(group_norm(h, **p["norm_out"]))
+        return conv2d(h, p["conv_out"]["w"], p["conv_out"]["b"])
+
+    def loss(self, params: Params, batch: Dict[str, jnp.ndarray],
+             rng: Optional[jax.Array] = None, train: bool = True):
+        """Reconstruction + KL (beta from batch or 1e-6, the SD-VAE
+        regime)."""
+        x = batch["pixel_values"]
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        mean, logvar = self.encode(params, x)
+        z = mean + jnp.exp(0.5 * logvar) * jax.random.normal(
+            rng, mean.shape, mean.dtype)
+        rec = self.decode(params, z)
+        rec_loss = jnp.mean((rec - x) ** 2)
+        kl = 0.5 * jnp.mean(mean ** 2 + jnp.exp(logvar) - 1.0 - logvar)
+        beta = float(batch.get("kl_weight", 1e-6))
+        loss = rec_loss + beta * kl
+        return loss, {"rec_loss": rec_loss, "kl": kl}
+
+    def sharding_rules(self, path, shape):
+        return None  # conv kernels are small; replicate (DP/fsdp via engine)
+
+
+# ======================================================================
+# Conditional UNet (UNet2DConditionModel-style)
+# ======================================================================
+@dataclasses.dataclass
+class UNetConfig:
+    in_channels: int = 4
+    out_channels: int = 4
+    base_channels: int = 32
+    channel_mults: Tuple[int, ...] = (1, 2, 4)
+    layers_per_block: int = 1
+    attn_levels: Tuple[int, ...] = (1, 2)  # levels with transformer blocks
+    num_heads: int = 4
+    cross_attention_dim: int = 64
+    dtype: str = "float32"
+
+    @property
+    def temb_dim(self) -> int:
+        return self.base_channels * 4
+
+
+class UNet2DCondition:
+    """Conditional UNet (reference serving surface:
+    ``module_inject/containers/unet.py`` policy over diffusers
+    ``UNet2DConditionModel``): timestep-embedded resnet trunks, self+cross
+    attention at the configured levels, skip connections down→up.
+
+    Training protocol (engine ``loss``): DDPM epsilon-prediction MSE with
+    uniformly sampled timesteps, the standard diffusion objective.
+    """
+
+    def __init__(self, config: Optional[UNetConfig] = None, seed: int = 0):
+        self.config = config or UNetConfig()
+        self.seed = seed
+
+    # ---------------------------------------------------------------- params
+    def _attn_pair(self, rng, c) -> Params:
+        cfg = self.config
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        return {"self": attn_block_params(k1, c),
+                "cross": attn_block_params(k2, c,
+                                           ctx_dim=cfg.cross_attention_dim),
+                "ff1": _lin_p(k3, c, 4 * c), "ff2": _lin_p(k4, 4 * c, c),
+                "ff_norm": _gn_p(c)}
+
+    def init_params(self, rng: Optional[jax.Array] = None) -> Params:
+        cfg = self.config
+        rng = rng if rng is not None else jax.random.PRNGKey(self.seed)
+        ks = iter(jax.random.split(rng, 128))
+        chans = [cfg.base_channels * m for m in cfg.channel_mults]
+        td = cfg.temb_dim
+        p: Params = {
+            "time_mlp": {"fc1": _lin_p(next(ks), cfg.base_channels, td),
+                         "fc2": _lin_p(next(ks), td, td)},
+            "conv_in": _conv_p(next(ks), 3, 3, cfg.in_channels, chans[0]),
+            "down": [], "up": [],
+        }
+        c = chans[0]
+        for lvl, co in enumerate(chans):
+            blk = {"res": [], "attn": []}
+            for j in range(cfg.layers_per_block):
+                blk["res"].append(resnet_block_params(
+                    next(ks), c if j == 0 else co, co, temb_dim=td))
+                if lvl in cfg.attn_levels:
+                    blk["attn"].append(self._attn_pair(next(ks), co))
+            if lvl < len(chans) - 1:
+                blk["down"] = _conv_p(next(ks), 3, 3, co, co)
+            p["down"].append(blk)
+            c = co
+        p["mid"] = {"res1": resnet_block_params(next(ks), c, c, temb_dim=td),
+                    "attn": self._attn_pair(next(ks), c),
+                    "res2": resnet_block_params(next(ks), c, c, temb_dim=td)}
+        # up path consumes skips: channel bookkeeping mirrors diffusers
+        skip_chans = [chans[0]]
+        for lvl, co in enumerate(chans):
+            skip_chans += [co] * cfg.layers_per_block
+            if lvl < len(chans) - 1:
+                skip_chans.append(co)
+        for lvl in reversed(range(len(chans))):
+            co = chans[lvl]
+            blk = {"res": [], "attn": []}
+            for j in range(cfg.layers_per_block + 1):
+                cin = c + skip_chans.pop()
+                blk["res"].append(resnet_block_params(next(ks), cin, co,
+                                                      temb_dim=td))
+                if lvl in cfg.attn_levels:
+                    blk["attn"].append(self._attn_pair(next(ks), co))
+                c = co
+            if lvl > 0:
+                blk["up"] = _conv_p(next(ks), 3, 3, co, co)
+            p["up"].append(blk)
+        p["norm_out"] = _gn_p(c)
+        p["conv_out"] = _conv_p(next(ks), 3, 3, c, cfg.out_channels)
+        return p
+
+    # --------------------------------------------------------------- forward
+    def _transformer(self, tp: Params, h: jnp.ndarray,
+                     context: jnp.ndarray) -> jnp.ndarray:
+        heads = self.config.num_heads
+        h = spatial_attention(tp["self"], h, heads=heads)
+        h = spatial_attention(tp["cross"], h, context=context, heads=heads)
+        b, hh, ww, c = h.shape
+        y = group_norm(h, **tp["ff_norm"]).reshape(b, hh * ww, c)
+        y = _lin(tp["ff2"], jax.nn.gelu(_lin(tp["ff1"], y)))
+        return h + y.reshape(b, hh, ww, c)
+
+    def apply(self, params: Params, sample: jnp.ndarray,
+              timesteps: jnp.ndarray,
+              encoder_hidden_states: jnp.ndarray) -> jnp.ndarray:
+        """``sample`` [B,H,W,Cin], ``timesteps`` [B], context [B,S,ctx] →
+        predicted noise [B,H,W,Cout]."""
+        cfg = self.config
+        temb = timestep_embedding(timesteps, cfg.base_channels)
+        temb = _lin(params["time_mlp"]["fc2"],
+                    jax.nn.silu(_lin(params["time_mlp"]["fc1"], temb)))
+        h = conv2d(sample, params["conv_in"]["w"], params["conv_in"]["b"])
+        skips = [h]
+        for blk in params["down"]:
+            for j, r in enumerate(blk["res"]):
+                h = resnet_block(r, h, temb)
+                if blk["attn"]:
+                    h = self._transformer(blk["attn"][j], h,
+                                          encoder_hidden_states)
+                skips.append(h)
+            if "down" in blk:
+                h = conv2d(h, blk["down"]["w"], blk["down"]["b"], stride=2)
+                skips.append(h)
+        m = params["mid"]
+        h = resnet_block(m["res1"], h, temb)
+        h = self._transformer(m["attn"], h, encoder_hidden_states)
+        h = resnet_block(m["res2"], h, temb)
+        for blk in params["up"]:
+            for j, r in enumerate(blk["res"]):
+                h = jnp.concatenate([h, skips.pop()], axis=-1)
+                h = resnet_block(r, h, temb)
+                if blk["attn"]:
+                    h = self._transformer(blk["attn"][j], h,
+                                          encoder_hidden_states)
+            if "up" in blk:
+                b, hh, ww, c = h.shape
+                h = jax.image.resize(h, (b, hh * 2, ww * 2, c), "nearest")
+                h = conv2d(h, blk["up"]["w"], blk["up"]["b"])
+        h = jax.nn.silu(group_norm(h, **params["norm_out"]))
+        return conv2d(h, params["conv_out"]["w"], params["conv_out"]["b"])
+
+    # ------------------------------------------------------------------ loss
+    def loss(self, params: Params, batch: Dict[str, jnp.ndarray],
+             rng: Optional[jax.Array] = None, train: bool = True):
+        """DDPM epsilon-prediction: noise latents at a random timestep,
+        predict the noise (the SD training objective)."""
+        x = batch["latents"]
+        ctx = batch["encoder_hidden_states"]
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        kt, kn = jax.random.split(rng)
+        b = x.shape[0]
+        t = jax.random.randint(kt, (b,), 0, 1000)
+        # cosine-ish ᾱ schedule, enough for the training objective
+        abar = jnp.cos((t.astype(jnp.float32) / 1000.0 + 0.008) / 1.008
+                       * jnp.pi / 2) ** 2
+        noise = jax.random.normal(kn, x.shape, x.dtype)
+        srt = jnp.sqrt(abar)[:, None, None, None]
+        srt1 = jnp.sqrt(1.0 - abar)[:, None, None, None]
+        noisy = srt * x + srt1 * noise
+        pred = self.apply(params, noisy, t, ctx)
+        loss = jnp.mean((pred - noise) ** 2)
+        return loss, {"eps_mse": loss}
+
+    def sharding_rules(self, path, shape):
+        names = [getattr(k, "key", getattr(k, "name", str(k)))
+                 for k in path]
+        s = "/".join(str(n) for n in names)
+        # the big matmuls (attention projections, FFN) shard over model
+        if s.endswith(("q/w", "k/w", "v/w", "ff1/w")):
+            return (None, "model")
+        if s.endswith(("o/w", "ff2/w")):
+            return ("model", None)
+        return None

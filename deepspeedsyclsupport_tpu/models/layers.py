@@ -306,6 +306,21 @@ def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 
 # --------------------------------------------------------------------------- blocks
+def _kv_memory_shardings():
+    """(host, device) shardings for a per-layer cache slice [B, len, KVH,
+    hd] under the world topology — TP keeps kv heads on the model axis in
+    BOTH memory spaces."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from ..comm.topology import get_world_topology
+
+    topo = get_world_topology()
+    spec = P(None, None, "model", None)
+    return (NamedSharding(topo.mesh, spec, memory_kind="pinned_host"),
+            NamedSharding(topo.mesh, spec, memory_kind="device"))
+
+
 def attention_block(p: Params, x: jnp.ndarray, cfg: ModelConfig,
                     positions: jnp.ndarray,
                     segment_ids: Optional[jnp.ndarray] = None,
@@ -343,9 +358,25 @@ def attention_block(p: Params, x: jnp.ndarray, cfg: ModelConfig,
     new_cache = None
     if kv_cache is not None:
         k_cache, v_cache, write_pos = kv_cache
+        # ZeRO-Inference KV offload: a host-resident cache (detected from
+        # the traced memory space) is updated IN host space — the new
+        # token's k/v hop to host, the single-token write stays there —
+        # and the full per-layer slice streams to device for attention.
+        # HBM holds one layer's cache at a time instead of all of them.
+        cache_space = getattr(k_cache.aval, "memory_space", None)
+        offloaded = (cache_space is not None
+                     and cache_space != getattr(k.aval, "memory_space",
+                                                cache_space))
+        if offloaded:
+            host_s, dev_s = _kv_memory_shardings()
+            k = jax.device_put(k, host_s)
+            v = jax.device_put(v, host_s)
         k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k, write_pos, axis=1)
         v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v, write_pos, axis=1)
         new_cache = (k_cache, v_cache, write_pos + s)
+        if offloaded:
+            k_cache = jax.device_put(k_cache, dev_s)
+            v_cache = jax.device_put(v_cache, dev_s)
         if kv_positions is not None:
             # ragged with true per-slot positions supplied (engine knows
             # slot→position): position-space causality, and alibi/window

@@ -314,21 +314,27 @@ class Engine:
         if "activation_checkpointing" in self.config.raw:
             ac = self.config.activation_checkpointing
             mcfg = getattr(self.module, "config", None)
-            if mcfg is not None and hasattr(mcfg, "remat"):
+            if mcfg is None or not hasattr(mcfg, "remat"):
+                logger.warning(
+                    "activation_checkpointing configured but the model does "
+                    "not expose a remat flag; apply jax.checkpoint in your "
+                    "model instead")
+            elif ac.partition_activations:
                 mcfg.remat = True
                 mcfg.remat_policy = ac.policy
                 log_dist(f"activation checkpointing on "
                          f"(policy={ac.policy})")
             else:
-                logger.warning(
-                    "activation_checkpointing configured but the model does "
-                    "not expose a remat flag; apply jax.checkpoint in your "
-                    "model instead")
+                # partition_activations=False turns remat OFF explicitly —
+                # section presence alone must not enable it (the autotuner
+                # sweeps both arms on a shared model object)
+                mcfg.remat = False
             if ac.cpu_checkpointing:
                 if mcfg is not None and hasattr(mcfg, "remat"):
                     # reference cpu_checkpointing: saved activations move to
                     # host instead of recomputing — the XLA host-offload
-                    # remat policy
+                    # remat policy (implies checkpointing is on)
+                    mcfg.remat = True
                     mcfg.remat_policy = "offload_dots_to_host"
                     log_dist("cpu_checkpointing: dot activations offload to "
                              "pinned host memory")

@@ -190,6 +190,46 @@ def tree_optimizer_shardings(opt_state, params, param_shardings, topo: MeshTopol
     return jax.tree_util.tree_map_with_path(rule, opt_state)
 
 
+def predict_memory_per_device(n_params: int, fsdp: int, stage: int, *,
+                              offload: bool = False,
+                              compute_bytes: int = 4,
+                              activation_bytes: float = 0.0,
+                              remat: bool = False,
+                              num_layers: int = 1) -> float:
+    """Predicted peak device bytes for one training step — the numeric core
+    behind :func:`describe_memory_plan`, used by the autotuner's
+    model-based pruning (reference ``autotuning/autotuner.py``
+    ``model_based_tuning`` / ``max_train_micro_batch_size``).
+
+    ``activation_bytes``: full no-remat activation footprint for the whole
+    stack at this micro-batch; with ``remat`` only ~one layer's worth is
+    live at a time (plus the per-layer residual stream checkpoints).
+    """
+    n = max(fsdp, 1)
+    param_factor = n if stage >= 3 and n > 1 else 1
+    grad_factor = n if stage >= 2 and n > 1 else 1
+    opt_factor = n if stage >= 1 and n > 1 else 1
+    if offload:
+        # device holds compute-dtype working params; fp32 master + moments
+        # live on host. Grads still materialize on device before the pull.
+        mem = n_params * compute_bytes / param_factor
+        mem += n_params * 4 / grad_factor
+    else:
+        mem = n_params * 4 / param_factor          # fp32 master
+        mem += n_params * 4 / grad_factor          # fp32 grads
+        mem += n_params * 8 / opt_factor           # adam moments
+        if compute_bytes != 4:
+            mem += n_params * compute_bytes / param_factor  # working cast
+    if remat:
+        layers = max(num_layers, 1)
+        # live layer + residual checkpoints — but never predict MORE than
+        # the no-remat footprint (shallow/unknown-depth models)
+        mem += min(activation_bytes, activation_bytes / layers * 2)
+    else:
+        mem += activation_bytes
+    return mem
+
+
 def describe_memory_plan(params, topo: MeshTopology, stage: int,
                          offload_device: Optional[str] = None) -> str:
     """Human-readable partition report (reference: ``see_memory_usage`` +

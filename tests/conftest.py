@@ -23,6 +23,15 @@ import jax  # noqa: E402
 # virtual 8-device mesh is what every test sees.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the suite is compile-bound (VERDICT r2 weak
+# #6 — the v2-engine tests alone build many jitted engine variants), and
+# most compiles repeat across files and across runs. ~/.cache-style dir keyed
+# by XLA fingerprint; safe to delete any time.
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("DSTPU_TEST_CACHE",
+                                 "/tmp/dstpu_jax_test_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import pytest  # noqa: E402
 
 

@@ -159,6 +159,109 @@ class TestQuantization:
         assert 0.2 <= d_mlp <= 0.3
 
 
+class TestStructuredCompression:
+    """Head/row/channel pruning + layer reduction (VERDICT r2 #10;
+    reference ``compression/compress.py`` + ``basic_layer`` masks)."""
+
+    def test_row_pruning_masks_output_columns(self):
+        from deepspeedsyclsupport_tpu.compression import compress
+
+        w = jax.random.normal(jax.random.PRNGKey(0), (16, 32))
+        cfg = {"compression_training": {"row_pruning": {
+            "shared_parameters": {"enabled": True},
+            "different_groups": {"rp1": {"params": {"dense_ratio": 0.25},
+                                         "modules": ["mlp*"]}}}}}
+        out = np.asarray(compress({"mlp": {"fc1": w}}, cfg)["mlp"]["fc1"])
+        col_alive = (np.abs(out).sum(axis=0) > 0)
+        assert col_alive.sum() == 8                 # 25% of 32 output cols
+        # kept columns are the highest-importance ones, untouched
+        imp = np.abs(np.asarray(w)).sum(axis=0)
+        assert set(np.where(col_alive)[0]) == set(np.argsort(imp)[-8:])
+        np.testing.assert_array_equal(out[:, col_alive],
+                                      np.asarray(w)[:, col_alive])
+
+    def test_channel_pruning_masks_input_rows(self):
+        from deepspeedsyclsupport_tpu.compression import compress
+
+        w = jax.random.normal(jax.random.PRNGKey(1), (32, 16))
+        cfg = {"compression_training": {"channel_pruning": {
+            "shared_parameters": {"enabled": True},
+            "different_groups": {"cp1": {"params": {"dense_ratio": 0.5},
+                                         "modules": ["*"]}}}}}
+        out = np.asarray(compress({"w": w}, cfg)["w"])
+        assert (np.abs(out).sum(axis=1) > 0).sum() == 16
+
+    def test_head_pruning_one_mask_from_wo(self):
+        """All attention matrices of a module share ONE head mask derived
+        from the output projection (disjoint per-matrix masks would zero
+        the whole attention output), per layer on stacked leaves."""
+        from deepspeedsyclsupport_tpu.compression import compress
+
+        h, hd, d, L = 8, 4, 32, 2
+        rng = jax.random.PRNGKey(2)
+        wo = jax.random.normal(rng, (L, h * hd, d))
+        wq = jax.random.normal(jax.random.fold_in(rng, 1), (L, d, h * hd))
+        cfg = {"compression_training": {"head_pruning": {
+            "shared_parameters": {"enabled": True, "num_heads": h},
+            "different_groups": {"hp1": {"params": {"dense_ratio": 0.5},
+                                         "modules": ["*attn*"]}}}}}
+        out = compress({"layers": {"attn": {"wo": wo, "wq": wq}}},
+                       cfg)["layers"]["attn"]
+        for layer in range(L):
+            wo_heads = np.asarray(out["wo"][layer]).reshape(h, hd, d)
+            wq_heads = np.asarray(out["wq"][layer]).reshape(d, h, hd)
+            dead_o = {i for i in range(h) if not np.abs(wo_heads[i]).sum()}
+            dead_q = {i for i in range(h)
+                      if not np.abs(wq_heads[:, i]).sum()}
+            assert len(dead_o) == 4
+            assert dead_o == dead_q  # one mask, not per-matrix masks
+            # the mask follows wo's importance in THIS layer
+            imp = np.abs(np.asarray(wo[layer])).reshape(h, -1).sum(axis=1)
+            assert dead_o == set(np.argsort(imp)[:4])
+
+    def test_head_pruning_requires_num_heads(self):
+        from deepspeedsyclsupport_tpu.compression import (
+            get_compression_config)
+
+        with pytest.raises(ValueError):
+            get_compression_config({"compression_training": {
+                "head_pruning": {"shared_parameters": {"enabled": True}}}})
+
+    def test_layer_reduction_student(self):
+        """Student keeps the chosen teacher layers and still runs."""
+        from deepspeedsyclsupport_tpu.compression import (
+            apply_layer_reduction)
+        from deepspeedsyclsupport_tpu.models import CausalLM
+
+        model = build_model("tiny", num_layers=4)
+        params = model.init_params(jax.random.PRNGKey(3))
+        cfg = {"compression_training": {"layer_reduction": {
+            "enabled": True, "keep_number_layer": 2,
+            "teacher_layer": [0, 3]}}}
+        new_cfg, new_params = apply_layer_reduction(model.config, params,
+                                                    cfg)
+        assert new_cfg.num_layers == 2
+        lw = jax.tree_util.tree_leaves(new_params["layers"])[0]
+        assert lw.shape[0] == 2
+        old = jax.tree_util.tree_leaves(params["layers"])[0]
+        np.testing.assert_array_equal(np.asarray(lw[1]), np.asarray(old[3]))
+        student = CausalLM(new_cfg)
+        ids = jnp.asarray(np.ones((2, 8), np.int32))
+        logits = student.apply(new_params, ids)
+        assert logits.shape == (2, 8, new_cfg.vocab_size)
+
+    def test_layer_reduction_validates_indices(self):
+        from deepspeedsyclsupport_tpu.compression import (
+            apply_layer_reduction)
+
+        model = build_model("tiny")
+        params = model.init_params(jax.random.PRNGKey(4))
+        with pytest.raises(ValueError):
+            apply_layer_reduction(model.config, params, {
+                "compression_training": {"layer_reduction": {
+                    "enabled": True, "teacher_layer": [0, 99]}}})
+
+
 # ------------------------------------------------------------------ autotuner
 class TestAutotuner:
     def test_picks_best_and_survives_failures(self):
@@ -179,6 +282,44 @@ class TestAutotuner:
         bad = [t for t in res.trials
                if t["train_micro_batch_size_per_gpu"] == -1]
         assert bad and bad[0]["throughput"] == float("-inf")
+
+    def test_multi_dim_space_with_memory_pruning(self):
+        """VERDICT r2 #8: zero × remat × offload × mbs dims, with
+        memory-model pruning keeping over-budget candidates from ever
+        compiling, and the tuner still finding the known-best config."""
+        from deepspeedsyclsupport_tpu.models import build_model
+
+        model = build_model("tiny", max_seq_len=64)
+
+        def make_batch(bs):
+            return {"input_ids": np.ones((bs, 32), np.int32)}
+
+        space = {
+            "train_micro_batch_size_per_gpu": [1, 1024],  # 1024: over budget
+            "zero_optimization.stage": [0, 2],
+            "activation_checkpointing.partition_activations": [False, True],
+            "zero_optimization.offload_optimizer.device": ["none", "cpu"],
+        }
+        # budget sized so mbs=1024 candidates prune out (tiny model:
+        # ~0.14M params; activations at mbs=1024 predict ~270 MB)
+        tuner = Autotuner(model, {"train_batch_size": 8,
+                                  "optimizer": {"type": "adam",
+                                                "params": {"lr": 1e-3}}},
+                          make_batch, space=space, steps=1, warmup=1,
+                          hbm_bytes=2e8, seq_len=32)
+        res = tuner.tune()
+        assert res.best_throughput > 0
+        assert res.best_config["train_micro_batch_size_per_gpu"] == 1
+        # every mbs=1024 candidate was pruned by the model, never measured
+        big = [t for t in res.trials
+               if t["train_micro_batch_size_per_gpu"] == 1024]
+        assert big and all(t.get("pruned") for t in big)
+        # at least one offload trial and one remat trial actually measured
+        measured = [t for t in res.trials if not t.get("pruned")]
+        assert any(t["zero_optimization.offload_optimizer.device"] == "cpu"
+                   for t in measured)
+        assert any(t["activation_checkpointing.partition_activations"]
+                   for t in measured)
 
 
 class TestNuma:
@@ -253,10 +394,13 @@ class TestNuma:
 
 
 class TestBenchLadder:
-    """bench.py resilience: the rung ladder must step down on failure and the
-    parent must not retry a timed-out (hung-tunnel) attempt."""
+    """bench.py resilience: the train ladder steps down on failure, and a
+    TPU rung timeout degrades the REMAINING rungs to pinned-CPU children
+    while partial results survive."""
 
-    def test_ladder_steps_down(self, monkeypatch):
+    def test_train_ladder_steps_down(self, monkeypatch):
+        import types
+
         import bench
 
         calls = []
@@ -272,31 +416,44 @@ class TestBenchLadder:
             platform = "tpu"
 
         monkeypatch.setattr(bench, "_measure", fake_measure)
-        import jax
-
-        monkeypatch.setattr(jax, "devices", lambda *a: [FakeDev()])
-        monkeypatch.setattr(jax, "clear_caches", lambda: None)
-        bench.run_bench()
+        monkeypatch.setattr(bench, "_child_jax", lambda: types.SimpleNamespace(
+            devices=lambda *a: [FakeDev()], clear_caches=lambda: None))
+        bench.run_train()
         assert len(calls) == 3
         assert calls[0][0] == "llama2-1b" and calls[2][0] == "llama-650m"
 
-    def test_parent_skips_retry_after_timeout(self, monkeypatch, capsys):
+    def test_parent_degrades_to_cpu_after_timeout(self, monkeypatch, capsys):
+        import json as _json
+
         import bench
 
         seen = []
 
-        def fake_spawn(overrides, timeout):
-            seen.append(dict(overrides))
-            if overrides.get("JAX_PLATFORMS") == "cpu":
-                return '{"metric": "m", "value": 1.0}', None
-            return None, "timeout: hung tunnel"
+        def fake_spawn(rung, timeout, env):
+            seen.append((rung, dict(env)))
+            if rung == "probe":
+                return [{"metric": "probe", "value": 1,
+                         "detail": {"platform": "tpu"}}], None
+            if rung == "kernels":
+                return [], f"{rung}: timeout after {timeout}s"
+            return [{"metric": f"{rung}_x", "value": 1.0, "unit": "u",
+                     "vs_baseline": 0.5, "detail": {}}], None
 
         monkeypatch.setattr(bench, "_spawn", fake_spawn)
         bench.main()
-        # native attempted ONCE (no retry after timeout), then cpu
-        assert len(seen) == 2
-        assert seen[1].get("JAX_PLATFORMS") == "cpu"
-        assert '"metric"' in capsys.readouterr().out
+        rungs = [r for r, _ in seen]
+        assert rungs == ["probe", "kernels", "train", "serve"]
+        # kernels timed out → remaining rungs run pinned to CPU
+        assert seen[2][1].get("JAX_PLATFORMS") == "cpu"
+        assert seen[3][1].get("JAX_PLATFORMS") == "cpu"
+        lines = capsys.readouterr().out.strip().splitlines()
+        head = _json.loads(lines[-1])
+        # aggregated headline: train wins, serve recorded under rungs,
+        # the timeout recorded honestly
+        assert head["metric"] == "train_x"
+        assert any(r["metric"] == "serve_x"
+                   for r in head["detail"]["rungs"])
+        assert any("timeout" in e for e in head["detail"]["rung_errors"])
 
 
 class TestSpatialAndTiling:

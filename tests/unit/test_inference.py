@@ -216,3 +216,40 @@ class TestRaggedArchZoo:
                                       max_new_tokens=6))
         assert list(got[0]) == _naive_greedy(model, params, p1, 6)
         assert list(got[1]) == _naive_greedy(model, params, p2, 6)
+
+
+class TestKVOffload:
+    """ZeRO-Inference KV-cache host offload (the other half of the 20x
+    claim — reference pairs weight quant with a CPU-side KV cache). On the
+    CPU sim the memory-kind annotation is a no-op placement-wise; the
+    check here is exact decode parity through the annotated program."""
+
+    def test_generate_parity_with_offload(self, tiny):
+        model, params = tiny
+        base = _engine(model, params)
+        off = _engine(model, params, kv_offload=True)
+        prompt = np.array([1, 5, 9, 200, 3], dtype=np.int32)
+        want = np.asarray(base.generate(jnp.asarray(prompt[None, :]),
+                                        max_new_tokens=8))
+        got = np.asarray(off.generate(jnp.asarray(prompt[None, :]),
+                                      max_new_tokens=8))
+        np.testing.assert_array_equal(got, want)
+
+    def test_offload_with_quantized_weights(self, tiny):
+        """The full ZeRO-Inference combination: int8 weights + host KV."""
+        model, params = tiny
+        off = _engine(model, params, kv_offload=True,
+                      quant={"enabled": True, "num_bits": 8})
+        prompt = np.array([7, 3, 11], dtype=np.int32)
+        got = np.asarray(off.generate(jnp.asarray(prompt[None, :]),
+                                      max_new_tokens=4))
+        assert got.shape == (1, 4)
+        # int8 round-trip shifts logits slightly; just demand valid ids
+        assert ((got >= 0) & (got < model.config.vocab_size)).all()
+
+    def test_config_key_parses(self):
+        from deepspeedsyclsupport_tpu.inference.config import (
+            DSTpuInferenceConfig)
+
+        assert DSTpuInferenceConfig.from_config({"kv_offload": True}).kv_offload
+        assert not DSTpuInferenceConfig.from_config({}).kv_offload
