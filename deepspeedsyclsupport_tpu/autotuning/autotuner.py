@@ -41,7 +41,7 @@ class TuneResult:
 DEFAULT_SPACE = {
     "train_micro_batch_size_per_gpu": [1, 2, 4, 8, 16],
     "zero_optimization.stage": [0, 1, 2, 3],
-    "activation_checkpointing.partition_activations": [False, True],
+    "activation_checkpointing.enabled": [False, True],
     "zero_optimization.offload_optimizer.device": ["none", "cpu"],
 }
 
@@ -127,7 +127,7 @@ class Autotuner:
                                   1))
         stage = int(self._effective(label, "zero_optimization.stage", 0))
         remat = bool(self._effective(
-            label, "activation_checkpointing.partition_activations", False))
+            label, "activation_checkpointing.enabled", False))
         offload = self._effective(
             label, "zero_optimization.offload_optimizer.device",
             "none") == "cpu"

@@ -22,9 +22,11 @@ per-leaf (stacked layer leaves are filled layer by layer), pushed to device
 against the target sharding, and the host buffer freed — peak host memory is
 one stacked leaf, never the model.
 
-Supported families: Llama/-2/-3, Mistral, Mixtral (MoE), Qwen2, GPT-2, OPT,
-BLOOM, Falcon (multi-query), GPT-NeoX, GPT-J, Phi — the superset of what the
-reference's FastGen zoo serves first-class.
+Supported families: Llama/-2/-3 (incl. attention_bias / InternLM layout),
+Mistral, Mixtral (MoE), Qwen2, GPT-2, GPT-Neo (alternating local attention,
+unscaled logits), OPT, BLOOM, Falcon (multi-query), GPT-NeoX, GPT-J, Phi —
+decoder side; BERT / DistilBERT / CLIP load via the encoder loaders below —
+the superset of what the reference's module_inject + FastGen zoos serve.
 """
 import json
 import os
@@ -82,6 +84,29 @@ def config_from_hf(hf: Dict[str, Any], **overrides) -> ModelConfig:
                   max_seq_len=hf.get("n_positions", 1024),
                   tie_embeddings=True, norm_type="layernorm",
                   pos_embed="learned", mlp_type="mlp", use_bias=True,
+                  rms_norm_eps=eps,
+                  activation=_map_activation(
+                      hf.get("activation_function", "gelu_new")))
+    elif mt == "gpt_neo":
+        d = hf.get("hidden_size", 2048)
+        # attention_types expands to a per-layer global/local pattern
+        # (HF GPTNeoConfig.expand_attention_types_params)
+        pattern = []
+        for item in hf.get("attention_types", [[["global", "local"], 12]]):
+            for _ in range(item[1]):
+                pattern.extend(item[0])
+        win = hf.get("window_size", 256)
+        kw = dict(vocab_size=hf.get("vocab_size", 50257), hidden_size=d,
+                  intermediate_size=hf.get("intermediate_size") or 4 * d,
+                  num_layers=hf.get("num_layers", 24),
+                  num_heads=hf.get("num_heads", 16),
+                  max_seq_len=hf.get("max_position_embeddings", 2048),
+                  tie_embeddings=True, norm_type="layernorm",
+                  pos_embed="learned", mlp_type="mlp", use_bias=True,
+                  qkv_bias=False,
+                  attn_scale=1.0,  # GPT-Neo does NOT scale logits by 1/sqrt(d)
+                  attn_windows=tuple(win if t == "local" else None
+                                     for t in pattern),
                   rms_norm_eps=eps,
                   activation=_map_activation(
                       hf.get("activation_function", "gelu_new")))
@@ -214,6 +239,11 @@ def config_from_hf(hf: Dict[str, Any], **overrides) -> ModelConfig:
             kw["sliding_window"] = int(hf["sliding_window"])
         if mt == "qwen2":
             kw["qkv_bias"] = True
+        if bool(hf.get("attention_bias", False)) or mt == "internlm":
+            # llama attention_bias=True / InternLM-v1 ("bias": true): q/k/v
+            # AND output projections carry biases
+            kw["qkv_bias"] = True
+            kw["attn_out_bias"] = True
         if mt == "mixtral" or "num_local_experts" in hf:
             kw.update(num_experts=hf.get("num_local_experts", 8),
                       num_experts_per_tok=hf.get("num_experts_per_tok", 2),
@@ -414,10 +444,12 @@ def _family_llama(cfg: ModelConfig):
             ("attn", "wv"): (pre + "self_attn.v_proj.weight", _t),
             ("attn", "wo"): (pre + "self_attn.o_proj.weight", _t),
         }
-        if cfg.qkv_bias:  # qwen2
+        if cfg.qkv_bias:  # qwen2 / attention_bias / internlm
             m[("attn", "bq")] = (pre + "self_attn.q_proj.bias", _id)
             m[("attn", "bk")] = (pre + "self_attn.k_proj.bias", _id)
             m[("attn", "bv")] = (pre + "self_attn.v_proj.bias", _id)
+        if cfg.attn_out_bias:
+            m[("attn", "bo")] = (pre + "self_attn.o_proj.bias", _id)
         m.update(_norm_leaves(("attn_norm",), pre + "input_layernorm", cfg))
         m.update(_norm_leaves(("mlp_norm",), pre + "post_attention_layernorm",
                               cfg))
@@ -456,6 +488,34 @@ def _family_gpt2(cfg: ModelConfig):
             ("mlp", "fc1"): (pre + "mlp.c_fc.weight", _id),
             ("mlp", "b1"): (pre + "mlp.c_fc.bias", _id),
             ("mlp", "fc2"): (pre + "mlp.c_proj.weight", _id),
+            ("mlp", "b2"): (pre + "mlp.c_proj.bias", _id),
+        }
+        m.update(_norm_leaves(("attn_norm",), pre + "ln_1", cfg))
+        m.update(_norm_leaves(("mlp_norm",), pre + "ln_2", cfg))
+        return m
+
+    return top, layer
+
+
+def _family_gpt_neo(cfg: ModelConfig):
+    def top():
+        m = {("embed", "embedding"): ("transformer.wte.weight", _id),
+             ("pos_embed", "embedding"): ("transformer.wpe.weight", _id)}
+        m.update(_norm_leaves(("final_norm",), "transformer.ln_f", cfg))
+        return m
+
+    def layer(i: int):
+        pre = f"transformer.h.{i}."
+        # nn.Linear [out, in] -> transpose; q/k/v carry NO bias, out does
+        m = {
+            ("attn", "wq"): (pre + "attn.attention.q_proj.weight", _t),
+            ("attn", "wk"): (pre + "attn.attention.k_proj.weight", _t),
+            ("attn", "wv"): (pre + "attn.attention.v_proj.weight", _t),
+            ("attn", "wo"): (pre + "attn.attention.out_proj.weight", _t),
+            ("attn", "bo"): (pre + "attn.attention.out_proj.bias", _id),
+            ("mlp", "fc1"): (pre + "mlp.c_fc.weight", _t),
+            ("mlp", "b1"): (pre + "mlp.c_fc.bias", _id),
+            ("mlp", "fc2"): (pre + "mlp.c_proj.weight", _t),
             ("mlp", "b2"): (pre + "mlp.c_proj.bias", _id),
         }
         m.update(_norm_leaves(("attn_norm",), pre + "ln_1", cfg))
@@ -696,7 +756,9 @@ def _family_phi(cfg: ModelConfig):
 FAMILIES = {
     "llama": _family_llama, "mistral": _family_llama,
     "mixtral": _family_llama, "qwen2": _family_llama,
-    "gpt2": _family_gpt2, "opt": _family_opt, "bloom": _family_bloom,
+    "internlm": _family_llama,
+    "gpt2": _family_gpt2, "gpt_neo": _family_gpt_neo,
+    "opt": _family_opt, "bloom": _family_bloom,
     "falcon": _family_falcon, "gpt_neox": _family_gpt_neox,
     "gptj": _family_gptj, "phi": _family_phi,
 }

@@ -257,10 +257,14 @@ def apply_layer_reduction(model_config, params: Any,
     if bad:
         raise ValueError(f"teacher_layer indices {bad} out of range for "
                          f"{n_layers} layers")
-    idx = jnp.asarray(keep, jnp.int32)
     new_params = dict(params)
-    new_params["layers"] = jax.tree_util.tree_map(
-        lambda leaf: jnp.take(leaf, idx, axis=0), params["layers"])
+    if isinstance(params["layers"], (list, tuple)):
+        # per-layer list layout (scan_layers=False): select entries
+        new_params["layers"] = [params["layers"][i] for i in keep]
+    else:
+        idx = jnp.asarray(keep, jnp.int32)
+        new_params["layers"] = jax.tree_util.tree_map(
+            lambda leaf: jnp.take(leaf, idx, axis=0), params["layers"])
     import dataclasses
 
     new_cfg = dataclasses.replace(model_config, num_layers=len(keep))

@@ -46,6 +46,13 @@ class ModelConfig:
     shared_block_norm: bool = False  # parallel block with ONE norm (gptj/falcon-7b/phi)
     embed_norm: bool = False        # layernorm right after embedding (bloom)
     sliding_window: Optional[int] = None  # Mistral-style local attention window
+    # non-standard attention logit scale (None => 1/sqrt(head_dim); GPT-Neo
+    # uses 1.0 — folded into q so every backend inherits it)
+    attn_scale: Optional[float] = None
+    # per-layer sliding windows (GPT-Neo alternating global/local pattern;
+    # None entries = global). Heterogeneous layers, so requires
+    # scan_layers=False (enforced in __post_init__).
+    attn_windows: Optional[Tuple[Optional[int], ...]] = None
 
     # MoE (Mixtral-family; reference: deepspeed/moe/sharded_moe.py)
     num_experts: int = 0            # 0 => dense MLP
@@ -95,6 +102,16 @@ class ModelConfig:
             raise ValueError(f"unknown mlp_type {self.mlp_type!r}")
         if self.shared_block_norm and not self.parallel_block:
             raise ValueError("shared_block_norm requires parallel_block")
+        if self.attn_windows is not None:
+            self.attn_windows = tuple(self.attn_windows)
+            if len(self.attn_windows) != self.num_layers:
+                raise ValueError(
+                    f"attn_windows has {len(self.attn_windows)} entries for "
+                    f"{self.num_layers} layers")
+            if self.scan_layers:
+                # per-layer windows make layers heterogeneous — the stacked
+                # lax.scan trunk requires identical layer programs
+                self.scan_layers = False
 
     @property
     def rotary_dim(self) -> int:

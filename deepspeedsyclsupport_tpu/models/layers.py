@@ -321,13 +321,17 @@ def _kv_memory_shardings():
             NamedSharding(topo.mesh, spec, memory_kind="device"))
 
 
+_WINDOW_FROM_CFG = object()  # sentinel: "use cfg.sliding_window"
+
+
 def attention_block(p: Params, x: jnp.ndarray, cfg: ModelConfig,
                     positions: jnp.ndarray,
                     segment_ids: Optional[jnp.ndarray] = None,
                     kv_cache: Optional[Tuple] = None,
                     impl: Optional[str] = None,
                     kv_mask: Optional[jnp.ndarray] = None,
-                    kv_positions: Optional[jnp.ndarray] = None):
+                    kv_positions: Optional[jnp.ndarray] = None,
+                    window_override=_WINDOW_FROM_CFG):
     """Self-attention sublayer: qkv proj → RoPE → attention → out proj.
 
     With ``kv_cache=(k_cache, v_cache, write_pos)`` runs in decode mode: appends
@@ -353,7 +357,14 @@ def attention_block(p: Params, x: jnp.ndarray, cfg: ModelConfig,
         k = apply_rope(k, positions, cfg.rope_theta, cfg.rotary_dim)
     alibi = (jnp.asarray(alibi_slopes(cfg.num_heads) * cfg.alibi_scale)
              if cfg.pos_embed == "alibi" else None)
-    window = cfg.sliding_window
+    window = (cfg.sliding_window if window_override is _WINDOW_FROM_CFG
+              else window_override)
+    if cfg.attn_scale is not None:
+        # non-standard logit scale (GPT-Neo uses 1.0, not 1/√d): fold the
+        # correction into q so every attention backend (flash kernel, xla
+        # oracle, ring/ulysses) inherits it without a kernel knob
+        q = q * jnp.asarray(cfg.attn_scale * np.sqrt(cfg.head_dim),
+                            q.dtype)
 
     new_cache = None
     if kv_cache is not None:
@@ -392,7 +403,10 @@ def attention_block(p: Params, x: jnp.ndarray, cfg: ModelConfig,
                 # (written at write_pos+i) sees slots <= write_pos+i;
                 # kv_mask supplies validity of the rest
                 kv_below = write_pos + jnp.arange(s)[None, :] + 1
-                if cfg.pos_embed == "alibi" or cfg.sliding_window:
+                if cfg.pos_embed == "alibi" or window is not None:
+                    # the EFFECTIVE window (cfg.sliding_window or the
+                    # per-layer override) — slot-space distances would be
+                    # silently wrong either way
                     raise ValueError(
                         "alibi/sliding-window ragged decode needs kv_positions"
                         " (slot index ≠ logical position would skew distances)")

@@ -137,7 +137,8 @@ class CausalLM:
 
     # ------------------------------------------------------------------ forward
     def _layer(self, p: Params, x: jnp.ndarray, positions, segment_ids,
-               cache_slice, rng, kv_mask=None, kv_positions=None
+               cache_slice, rng, kv_mask=None, kv_positions=None,
+               layer_idx: Optional[int] = None
                ) -> Tuple[jnp.ndarray, Any, jnp.ndarray]:
         cfg = self.config
         # ZeRO-Inference: int8 QuantTensor leaves dequantize here, inside the
@@ -154,10 +155,16 @@ class CausalLM:
                 return moe_mlp(p["moe"], y, cfg, rng)
             return mlp_block(p["mlp"], y, cfg), jnp.zeros((), jnp.float32)
 
+        from .layers import _WINDOW_FROM_CFG
+
+        window = (cfg.attn_windows[layer_idx]
+                  if cfg.attn_windows is not None and layer_idx is not None
+                  else _WINDOW_FROM_CFG)
         x_norm = norm(x, p["attn_norm"], cfg)
         h, new_cache = attention_block(
             p["attn"], x_norm, cfg, positions, segment_ids, cache_slice,
-            kv_mask=kv_mask, kv_positions=kv_positions)
+            kv_mask=kv_mask, kv_positions=kv_positions,
+            window_override=window)
         if cfg.parallel_block:
             # GPT-J/NeoX/Falcon/Phi residual form: x + attn(norm(x)) + mlp(·),
             # with the MLP reading either the same norm (shared_block_norm)
@@ -203,13 +210,14 @@ class CausalLM:
             x = norm(x, params["embed_norm"], cfg)
         x = constrain(x, BATCH, "seq", None)
 
-        def layer_fn(x, p, ck, cv, rng_l):
+        def layer_fn(x, p, ck, cv, rng_l, layer_idx=None):
             cache_slice = None
             if cache is not None:
                 cache_slice = (ck, cv, cache.write_pos)
             x, new_c, aux = self._layer(p, x, positions, segment_ids,
                                         cache_slice, rng_l, kv_mask=kv_mask,
-                                        kv_positions=kv_positions)
+                                        kv_positions=kv_positions,
+                                        layer_idx=layer_idx)
             nck, ncv = (new_c[0], new_c[1]) if new_c is not None else (ck, cv)
             return x, nck, ncv, aux
 
@@ -222,7 +230,9 @@ class CausalLM:
                     "device", "pinned_host")
             elif cfg.remat_policy and cfg.remat_policy != "nothing_saveable":
                 policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
-            layer_fn = jax.checkpoint(layer_fn, policy=policy)
+            # layer_idx is a STATIC python arg (per-layer window selection)
+            layer_fn = jax.checkpoint(layer_fn, policy=policy,
+                                      static_argnums=(5,))
 
         new_cache = None
         rltd_keep = cfg.random_ltd_current
@@ -338,7 +348,7 @@ class CausalLM:
             def body(x, inp):
                 p, ck, cv, rng_l, li = inp
                 if not use_pld:
-                    x, nck, ncv, aux = layer_fn(x, p, ck, cv, rng_l)
+                    x, nck, ncv, aux = layer_fn(x, p, ck, cv, rng_l, None)
                     return x, ((nck, ncv), aux)
                 keep_p = 1.0 - (li + 1).astype(jnp.float32) / cfg.num_layers \
                     * (1.0 - pld_theta)
@@ -346,7 +356,7 @@ class CausalLM:
                                             keep_p)
 
                 def run(_):
-                    return layer_fn(x, p, ck, cv, rng_l)
+                    return layer_fn(x, p, ck, cv, rng_l, None)
 
                 def skip(_):
                     return x, ck, cv, jnp.zeros((), jnp.float32)
@@ -377,11 +387,11 @@ class CausalLM:
                         jax.random.fold_in(rng_l, 17), keep_p)
                     x, nck, ncv, aux = jax.lax.cond(
                         keep,
-                        lambda _: layer_fn(x, p, ck, cv, rng_l),
+                        lambda _: layer_fn(x, p, ck, cv, rng_l, i),
                         lambda _: (x, ck, cv, jnp.zeros((), jnp.float32)),
                         None)
                 else:
-                    x, nck, ncv, aux = layer_fn(x, p, ck, cv, rng_l)
+                    x, nck, ncv, aux = layer_fn(x, p, ck, cv, rng_l, i)
                 aux_total = aux_total + aux
                 if cache is not None:
                     nks.append(nck)

@@ -224,6 +224,12 @@ class ActivationCheckpointingConfig:
     """``activation_checkpointing`` (reference:
     ``runtime/activation_checkpointing/checkpointing.py``). Under XLA this maps to
     ``jax.checkpoint`` policies rather than manual save/recompute."""
+    # section presence turns checkpointing ON unless explicitly disabled
+    # ("enabled" is a dstpu extension: the reference has no off-switch in
+    # the section, and partition_activations means TP-partitioning there,
+    # NOT enablement — ported configs with partition_activations=false
+    # still expect remat on)
+    enabled: bool = True
     partition_activations: bool = False
     number_checkpoints: Optional[int] = None
     contiguous_memory_optimization: bool = False
@@ -246,7 +252,8 @@ class ActivationCheckpointingConfig:
                 f"activation_checkpointing.policy {policy!r} is not a "
                 f"supported jax.checkpoint policy; choose one of "
                 f"{cls.VALID_POLICIES}")
-        return cls(partition_activations=bool(d.get("partition_activations", False)),
+        return cls(enabled=bool(d.get("enabled", True)),
+                   partition_activations=bool(d.get("partition_activations", False)),
                    number_checkpoints=d.get("number_checkpoints"),
                    contiguous_memory_optimization=bool(
                        d.get("contiguous_memory_optimization", False)),

@@ -319,15 +319,16 @@ class Engine:
                     "activation_checkpointing configured but the model does "
                     "not expose a remat flag; apply jax.checkpoint in your "
                     "model instead")
-            elif ac.partition_activations:
+            elif ac.enabled:
+                # section presence = on (ported reference configs carry
+                # partition_activations=false and still expect remat)
                 mcfg.remat = True
                 mcfg.remat_policy = ac.policy
                 log_dist(f"activation checkpointing on "
                          f"(policy={ac.policy})")
             else:
-                # partition_activations=False turns remat OFF explicitly —
-                # section presence alone must not enable it (the autotuner
-                # sweeps both arms on a shared model object)
+                # explicit "enabled": false turns remat OFF — the
+                # autotuner's off-arm on a shared model object
                 mcfg.remat = False
             if ac.cpu_checkpointing:
                 if mcfg is not None and hasattr(mcfg, "remat"):

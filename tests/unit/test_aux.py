@@ -297,7 +297,7 @@ class TestAutotuner:
         space = {
             "train_micro_batch_size_per_gpu": [1, 1024],  # 1024: over budget
             "zero_optimization.stage": [0, 2],
-            "activation_checkpointing.partition_activations": [False, True],
+            "activation_checkpointing.enabled": [False, True],
             "zero_optimization.offload_optimizer.device": ["none", "cpu"],
         }
         # budget sized so mbs=1024 candidates prune out (tiny model:
@@ -318,7 +318,7 @@ class TestAutotuner:
         measured = [t for t in res.trials if not t.get("pruned")]
         assert any(t["zero_optimization.offload_optimizer.device"] == "cpu"
                    for t in measured)
-        assert any(t["activation_checkpointing.partition_activations"]
+        assert any(t["activation_checkpointing.enabled"]
                    for t in measured)
 
 

@@ -216,10 +216,10 @@ def test_activation_checkpointing_config_drives_remat():
     engine, _, _, _ = dstpu.initialize(model=model, config=cfg)
     assert model.config.remat is True
     assert model.config.remat_policy == "dots_saveable"
-    # the False arm turns remat OFF explicitly (autotuner sweeps both arms
-    # on a shared model object; section presence alone must not enable it)
-    cfg_off = simple_config(activation_checkpointing={
-        "partition_activations": False})
+    # explicit "enabled": false turns remat OFF (the autotuner's off-arm
+    # on a shared model object); mere partition_activations=false keeps it
+    # ON, matching ported reference configs
+    cfg_off = simple_config(activation_checkpointing={"enabled": False})
     cfg_off["train_batch_size"] = 16
     dstpu.initialize(model=model, config=cfg_off)
     assert model.config.remat is False

@@ -116,6 +116,27 @@ def _case_gpt_neox():
         hidden_dropout=0.0, attention_dropout=0.0))
 
 
+def _case_llama_bias():
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    # attention_bias=True == the InternLM-v1 layout (containers internlm)
+    return LlamaForCausalLM(LlamaConfig(
+        vocab_size=V, hidden_size=D, intermediate_size=48,
+        num_hidden_layers=L, num_attention_heads=H, num_key_value_heads=2,
+        max_position_embeddings=64, attention_bias=True))
+
+
+def _case_gpt_neo():
+    from transformers import GPTNeoConfig, GPTNeoForCausalLM
+
+    # alternating global/local layers + UNSCALED attention logits
+    return GPTNeoForCausalLM(GPTNeoConfig(
+        vocab_size=V, hidden_size=D, num_layers=L, num_heads=H,
+        intermediate_size=48, max_position_embeddings=64,
+        attention_types=[[["global", "local"], 1]], window_size=8,
+        resid_dropout=0.0, embed_dropout=0.0, attention_dropout=0.0))
+
+
 def _case_gptj():
     from transformers import GPTJConfig, GPTJForCausalLM
 
@@ -136,8 +157,10 @@ def _case_phi():
 
 
 CASES = {
-    "llama": _case_llama, "mistral": _case_mistral, "mixtral": _case_mixtral,
-    "qwen2": _case_qwen2, "gpt2": _case_gpt2, "opt": _case_opt,
+    "llama": _case_llama, "llama_bias": _case_llama_bias,
+    "mistral": _case_mistral, "mixtral": _case_mixtral,
+    "qwen2": _case_qwen2, "gpt2": _case_gpt2,
+    "gpt_neo": _case_gpt_neo, "opt": _case_opt,
     "bloom": _case_bloom, "falcon": _case_falcon,
     "falcon_rw": _case_falcon_rw, "gpt_neox": _case_gpt_neox,
     "gptj": _case_gptj, "phi": _case_phi,
