@@ -1221,3 +1221,116 @@ def load_hf_clip_checkpoint(path: str, dtype: Any = None):
     src.close()
     log_dist(f"loaded HF CLIP checkpoint {path}")
     return model, params
+
+
+# ======================================================================
+# Megatron-LM GPT checkpoints (reference containers/megatron_gpt.py —
+# fused per-head query_key_value, megatron_v2 layout)
+# ======================================================================
+def load_megatron_checkpoint(path: str, num_heads: int, dtype: Any = None,
+                             config_overrides: Optional[Dict] = None):
+    """Load a Megatron-LM GPT checkpoint (``model_optim_rng.pt``-style
+    torch state dict) into ``(CausalLM, params)``.
+
+    Reference analog: ``module_inject/containers/megatron_gpt.py``
+    (MegatronLayerPolicy over ``ParallelTransformerLayer``: fused
+    ``query_key_value`` [3·d, d] in the per-head megatron-v2 layout —
+    decoded by the same ``_fused3`` helper BLOOM/NeoX use — ``dense``,
+    ``mlp.dense_h_to_4h`` / ``dense_4h_to_h``, input/post_attention
+    layernorms). ``num_heads`` cannot be inferred from shapes and must be
+    supplied (megatron args carry it out of band). ``dtype`` casts
+    floating leaves during assembly; ``config_overrides`` reach
+    :class:`ModelConfig` (e.g. ``{"dtype": "float32"}`` for the compute
+    dtype, ``{"activation": "gelu"}`` for tanh-gelu checkpoints). Handles learned-absolute OR rotary
+    positions and tied OR untied (``output_layer``) unembeddings.
+    """
+    import torch
+
+    from ..models.transformer import CausalLM
+
+    sd = torch.load(path, map_location="cpu", weights_only=False)
+    sd = sd.get("model", sd)
+    lm = sd.get("language_model", sd)
+    emb = lm["embedding"]
+    enc = lm.get("encoder", lm.get("transformer"))
+    if enc is None:
+        raise ValueError("no encoder/transformer section in checkpoint")
+
+    def npy(t):
+        t = t.float() if t.dtype == torch.bfloat16 else t
+        a = t.numpy()
+        if dtype is not None and jnp.issubdtype(a.dtype, jnp.floating):
+            a = a.astype(dtype)
+        return a
+
+    word = npy(emb["word_embeddings"]["weight"])
+    pos = (npy(emb["position_embeddings"]["weight"])
+           if "position_embeddings" in emb else None)
+    untied = lm.get("output_layer")
+    n_layers = 1 + max(int(k.split(".")[1]) for k in enc
+                       if k.startswith("layers."))
+    d = word.shape[1]
+    hd = d // num_heads
+    kw = dict(vocab_size=word.shape[0], hidden_size=d,
+              intermediate_size=enc[
+                  "layers.0.mlp.dense_h_to_4h.weight"].shape[0],
+              num_layers=n_layers, num_heads=num_heads,
+              tie_embeddings=untied is None,
+              norm_type="layernorm",
+              pos_embed="learned" if pos is not None else "rope",
+              mlp_type="mlp", use_bias=True,
+              activation="gelu_exact", rms_norm_eps=1e-5)
+    if pos is not None:
+        kw["max_seq_len"] = pos.shape[0]
+    kw.update(config_overrides or {})
+    cfg = ModelConfig(**kw)
+    model = CausalLM(cfg)
+
+    def layer_leaves(i):
+        pre = f"layers.{i}."
+        att = (pre + "self_attention."
+               if pre + "self_attention.query_key_value.weight" in enc
+               else pre + "attention.")
+        qkv_w = npy(enc[att + "query_key_value.weight"])
+        qkv_b = npy(enc[att + "query_key_value.bias"])
+        leaves = {
+            "attn": {"wq": _fused3(0, num_heads, hd)(qkv_w),
+                     "wk": _fused3(1, num_heads, hd)(qkv_w),
+                     "wv": _fused3(2, num_heads, hd)(qkv_w),
+                     "bq": _fused3(0, num_heads, hd)(qkv_b),
+                     "bk": _fused3(1, num_heads, hd)(qkv_b),
+                     "bv": _fused3(2, num_heads, hd)(qkv_b),
+                     "wo": _t(npy(enc[att + "dense.weight"])),
+                     "bo": npy(enc[att + "dense.bias"])},
+            "attn_norm": {"scale": npy(enc[pre + "input_layernorm.weight"]),
+                          "bias": npy(enc[pre + "input_layernorm.bias"])},
+            "mlp": {"fc1": _t(npy(enc[pre + "mlp.dense_h_to_4h.weight"])),
+                    "b1": npy(enc[pre + "mlp.dense_h_to_4h.bias"]),
+                    "fc2": _t(npy(enc[pre + "mlp.dense_4h_to_h.weight"])),
+                    "b2": npy(enc[pre + "mlp.dense_4h_to_h.bias"])},
+            "mlp_norm": {"scale": npy(
+                             enc[pre + "post_attention_layernorm.weight"]),
+                         "bias": npy(
+                             enc[pre + "post_attention_layernorm.bias"])},
+        }
+        return leaves
+
+    per_layer = [layer_leaves(i) for i in range(n_layers)]
+    if cfg.scan_layers:
+        layers: Any = jax.tree_util.tree_map(lambda *ls: np.stack(ls),
+                                             *per_layer)
+    else:
+        layers = per_layer
+    params = {
+        "embed": {"embedding": word},
+        "layers": layers,
+        "final_norm": {"scale": npy(enc["final_layernorm.weight"]),
+                       "bias": npy(enc["final_layernorm.bias"])},
+    }
+    if pos is not None:
+        params["pos_embed"] = {"embedding": pos}
+    if untied is not None:
+        params["lm_head"] = {"kernel": _t(npy(untied["weight"]))}
+    log_dist(f"loaded Megatron-LM checkpoint {path}: {n_layers} layers, "
+             f"d={d}, {'tied' if untied is None else 'untied'} unembed")
+    return model, params
