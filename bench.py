@@ -558,16 +558,9 @@ def _serve_once(model_name, platform, *, n_clients, reqs_per_client,
         return [int(t) for t in rng.randint(1, cfg.vocab_size - 1,
                                             size=prompt_len)]
 
-    # warmup: compile prefill + decode graphs outside the timed window.
-    # Each runs TWICE: the first jitted call returns a donated KV whose
-    # sharding differs from init_blocked_kv's placement, so the second call
-    # in each state compiles the steady-state variant serving actually hits.
-    w = eng.put([1], [mk_prompt()[:budget - 1]])
-    tok = int(np.argmax(w[1]))
-    eng.put([1], [[tok]])
-    eng.put([2], [mk_prompt()[:budget // 2]])
-    eng.put([1], [[tok]])
-    eng.flush([1, 2])
+    # compile prefill + decode in both KV-sharding states outside the
+    # timed window (engine-owned warmup; see InferenceEngineV2.warmup)
+    eng.warmup()
 
     results = {}
     for i, mode in enumerate(("naive", "splitfuse")):

@@ -426,3 +426,17 @@ class TestSerialize:
         a = eng.put([1], [prompt])[1]
         b = eng2.put([1], [prompt])[1]
         np.testing.assert_allclose(a, b, rtol=5e-2, atol=5e-2)
+
+
+class TestWarmup:
+    def test_warmup_leaves_engine_clean_and_serving_exact(self, tiny):
+        """warmup() compiles both KV-sharding states, releases all its
+        state, and does not perturb subsequent decoding."""
+        model, params = tiny
+        eng = _v2(model, params)
+        eng.warmup()
+        assert not eng.seqs
+        assert eng.allocator.free_blocks == eng.config.num_blocks
+        prompt = [7, 3, 11]
+        got = eng.generate([prompt], max_new_tokens=4)[0]
+        assert got == _naive_greedy(model, params, prompt, 4)
