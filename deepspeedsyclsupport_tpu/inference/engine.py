@@ -179,11 +179,13 @@ class InferenceEngine:
                  f"→ {after / 2**20:.1f} MB int8")
 
     # ------------------------------------------------------------------ forward
-    def forward(self, input_ids: jnp.ndarray) -> jnp.ndarray:
-        """Full-sequence logits (reference ``InferenceEngine.forward``)."""
+    def forward(self, input_ids: jnp.ndarray, *args) -> jnp.ndarray:
+        """Full-sequence logits (reference ``InferenceEngine.forward``).
+        Extra positional args pass through to ``module.apply`` — encoder
+        models (BERT-family) take attention_mask / token_type_ids here."""
         if self._forward_fn is None:
             self._forward_fn = jax.jit(self.module.apply)
-        return self._forward_fn(self.params, input_ids)
+        return self._forward_fn(self.params, input_ids, *args)
 
     __call__ = forward
 

@@ -176,16 +176,20 @@ class InferenceEngineV2:
         the CPU sim; worse on TPU)."""
         cfg = self.config
         uid = -(1 << 40) - 1   # reserved: below any sane caller uid
-        n = max(2, min(cfg.max_tokens_per_batch - 1, 8))
-        out = self.put([uid], [[1] * n])
-        if uid not in out:
-            raise RuntimeError(
-                f"warmup could not admit its sequence — call warmup() on an "
-                f"idle engine ({dict(out.admission.reasons)})")
-        tok = int(np.argmax(out[uid]))
-        self.put([uid], [[tok]])               # decode path, state A
-        self.put([uid], [[tok, tok]])          # prefill path, state B
-        self.put([uid], [[tok]])               # decode path, state B
+        # leave room for the 4 follow-up tokens within max_context
+        n = max(2, min(cfg.max_tokens_per_batch - 1, cfg.max_context - 4, 8))
+        steps = ([[1] * n],                    # prefill, state A
+                 [[2]],                        # decode path, state A
+                 [[2, 2]],                     # prefill path, state B
+                 [[2]])                        # decode path, state B
+        out = None
+        for toks in steps:
+            out = self.put([uid], toks)
+            if uid not in out and out.admission.rejected:
+                self.flush([uid])
+                raise RuntimeError(
+                    f"warmup could not admit its sequence — call warmup() "
+                    f"on an idle engine ({dict(out.admission.reasons)})")
         self.flush([uid])
 
     # ------------------------------------------------------------- scheduling

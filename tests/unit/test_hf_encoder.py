@@ -309,3 +309,31 @@ class TestMegatronIngestion:
         ours = np.asarray(model.apply(
             jax.tree_util.tree_map(jnp.asarray, params), jnp.asarray(ids)))
         np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+class TestEncoderServing:
+    def test_bert_through_init_inference(self, tmp_path):
+        """Encoder serving through the v1 engine (the reference serves BERT
+        via kernel injection — here TP-sharded placement + jitted apply)."""
+        from transformers import BertConfig, BertForMaskedLM
+
+        from deepspeedsyclsupport_tpu.inference import init_inference
+
+        hf = BertForMaskedLM(BertConfig(
+            vocab_size=V, hidden_size=D, num_hidden_layers=L,
+            num_attention_heads=H, intermediate_size=48,
+            max_position_embeddings=64, type_vocab_size=2,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0))
+        hf.eval()
+        hf.save_pretrained(tmp_path)
+        model, params = load_hf_encoder_checkpoint(str(tmp_path))
+        eng = init_inference(model=model, params=params,
+                             config={"dtype": "fp32",
+                                     "tensor_parallel": {"tp_size": 2}})
+        ids = _ids(np.random.default_rng(13))
+        mask = np.ones_like(ids)
+        with torch.no_grad():
+            theirs = hf(input_ids=torch.tensor(ids, dtype=torch.long)
+                        ).logits.numpy()
+        ours = np.asarray(eng.forward(jnp.asarray(ids), jnp.asarray(mask)))
+        np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
