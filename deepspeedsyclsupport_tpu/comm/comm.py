@@ -36,6 +36,13 @@ __all__ = [
     "broadcast", "pmean", "axis_size", "axis_index", "send_recv_next",
     "send_recv_prev", "init_distributed", "is_initialized", "barrier",
     "get_world_size", "get_rank", "get_local_rank", "get_device_count",
+    # reference-surface parity (root-based ops, p2p, coalesced, aliases)
+    "reduce", "gather", "scatter", "p2p", "send", "recv",
+    "all_reduce_coalesced", "all_gather_coalesced",
+    "all_gather_into_tensor", "reduce_scatter_tensor", "all_to_all_single",
+    "inference_all_reduce", "monitored_barrier", "new_group",
+    "get_global_rank", "get_world_group", "get_all_ranks_from_group",
+    "destroy_process_group",
 ]
 
 _INITIALIZED = False
@@ -322,3 +329,170 @@ def barrier():
     x = jnp.ones((jax.local_device_count(),))
     jax.block_until_ready(
         jax.pmap(lambda v: lax.psum(v, "i"), axis_name="i")(x))
+
+
+# ======================================================================
+# Reference-surface parity: root-based ops, p2p, coalesced variants and
+# torch-compat aliases (reference comm/comm.py public API). Under SPMD
+# every rank executes the same program, so "root" semantics become
+# value-selection: non-root ranks get a defined value (documented per op)
+# instead of being bystanders.
+# ======================================================================
+def reduce(x, axis_name, dst: int = 0):
+    """Sum-reduce onto ``dst`` (reference ``comm.reduce``): the reduced
+    value lands on rank ``dst``; every other rank keeps its input (the
+    closest SPMD analog of torch's in-place root semantics)."""
+    total = all_reduce(x, axis_name)   # honors the ALL_REDUCE kill switch
+    return jnp.where(lax.axis_index(axis_name) == dst, total, x)
+
+
+def gather(x, axis_name, dst: int = 0):
+    """Gather shards onto ``dst`` (reference ``comm.gather``). SPMD has no
+    bystanders, so EVERY rank receives the stacked [world, ...] result —
+    rank ``dst`` reads it, others may ignore it (XLA DCEs unused outputs).
+    """
+    del dst  # root semantics dissolve under SPMD; kept for API parity
+    return all_gather(x, axis_name)
+
+
+def scatter(x, axis_name, src: int = 0):
+    """Scatter rank ``src``'s leading-dim shards (reference
+    ``comm.scatter``): input [world, ...] on ``src``; every rank returns
+    its own [...] shard."""
+    _log("scatter", axis_name, x)
+    full = broadcast(x, axis_name, src=src)
+    return lax.dynamic_index_in_dim(full, lax.axis_index(axis_name), 0,
+                                    keepdims=False)
+
+
+def p2p(x, src: int, dst: int, axis_name):
+    """Point-to-point transfer (reference ``send``/``recv`` pair, one
+    collective under SPMD): rank ``dst`` returns rank ``src``'s value,
+    every other rank keeps its own."""
+    moved = ppermute(x, axis_name, [(src, dst)])  # honors the P2P switch
+    return jnp.where(lax.axis_index(axis_name) == dst, moved, x)
+
+
+def send(x, dst: int, axis_name, src: Optional[int] = None):
+    """Reference ``comm.send``. SPMD is collective: the matching recv is
+    the SAME call on the receiving rank, so ``send``/``recv`` both map to
+    :func:`p2p`. ``src`` is REQUIRED — "the caller's rank" is not a
+    static value under jit."""
+    if src is None:
+        raise ValueError("SPMD send needs the static source rank: "
+                         "send(x, dst, axis, src=<rank>) — or use p2p()")
+    return p2p(x, src, dst, axis_name)
+
+
+def recv(x, src: int, axis_name, dst: Optional[int] = None):
+    """Reference ``comm.recv`` — see :func:`send`."""
+    if dst is None:
+        raise ValueError("SPMD recv needs the static destination rank: "
+                         "recv(x, src, axis, dst=<rank>) — or use p2p()")
+    return p2p(x, src, dst, axis_name)
+
+
+def all_reduce_coalesced(tensors, axis_name):
+    """Reference ``all_reduce_coalesced``: one call over a list/pytree.
+    XLA fuses the resulting psums, which is exactly what torch's
+    coalescing manager buys."""
+    return jax.tree_util.tree_map(lambda t: all_reduce(t, axis_name),
+                                  tensors)
+
+
+def all_gather_coalesced(tensors, axis_name):
+    """Reference ``all_gather_coalesced`` over a list/pytree."""
+    return jax.tree_util.tree_map(lambda t: all_gather(t, axis_name),
+                                  tensors)
+
+
+# ----- torch-compat aliases (reference keeps both spellings alive) -----
+def all_gather_into_tensor(x, axis_name):
+    """Reference ``all_gather_into_tensor`` (tensor-form all_gather)."""
+    return all_gather(x, axis_name)
+
+
+def reduce_scatter_tensor(x, axis_name):
+    """Reference ``reduce_scatter_tensor`` (tensor-form reduce_scatter)."""
+    return reduce_scatter(x, axis_name)
+
+
+def all_to_all_single(x, axis_name, split_axis: int = 0,
+                      concat_axis: int = 0, **kw):
+    """Reference ``all_to_all_single`` (torch splits/concats dim 0
+    implicitly — same defaults here)."""
+    return all_to_all(x, axis_name, split_axis=split_axis,
+                      concat_axis=concat_axis, **kw)
+
+
+def inference_all_reduce(x, axis_name):
+    """Reference ``inference_all_reduce`` (the op-builder fast path; XLA's
+    psum IS the fast path here)."""
+    return all_reduce(x, axis_name)
+
+
+def monitored_barrier(timeout=None):
+    """Reference ``monitored_barrier`` — barrier + log (straggler
+    attribution needs no special path when XLA collectives deadlock
+    loudly)."""
+    del timeout
+    _log("monitored_barrier", "world", jnp.zeros(()))
+    barrier()
+
+
+def get_global_rank(group=None, group_rank: int = 0) -> int:
+    """Reference ``get_global_rank``: resolve a group-relative rank.
+    Groups here are mesh axes or :func:`new_group` rank lists."""
+    if isinstance(group, _RankGroup):
+        return group.ranks[group_rank]
+    return group_rank
+
+
+def get_world_group():
+    """Reference ``get_world_group``."""
+    return _RankGroup(tuple(range(get_world_size())))
+
+
+def get_all_ranks_from_group(group=None):
+    """Reference ``get_all_ranks_from_group``."""
+    if group is None:
+        group = get_world_group()
+    return list(group.ranks)
+
+
+class _RankGroup:
+    """Lightweight process-group handle (reference ``new_group`` returns a
+    torch ProcessGroup). Collectives over arbitrary rank subsets are a
+    MESH property under SPMD — build a topology whose axis holds these
+    ranks (``build_topology``) instead of passing the handle to a
+    collective."""
+
+    def __init__(self, ranks):
+        self.ranks = tuple(int(r) for r in ranks)
+
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def __repr__(self):
+        return f"_RankGroup(ranks={self.ranks})"
+
+
+def new_group(ranks):
+    """Reference ``comm.new_group``. Returns a rank-list handle for
+    bookkeeping APIs (:func:`get_global_rank`,
+    :func:`get_all_ranks_from_group`); express subset COLLECTIVES as mesh
+    axes (see :class:`_RankGroup`)."""
+    return _RankGroup(ranks)
+
+
+def destroy_process_group(group=None):
+    """Reference ``destroy_process_group`` — jax.distributed teardown for
+    the world group, no-op for sub-groups."""
+    global _INITIALIZED
+    if group is None or isinstance(group, _RankGroup) and \
+            len(group.ranks) == get_world_size():
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # single-controller / already down
+            pass
+        _INITIALIZED = False  # torch parity: is_initialized() goes False
