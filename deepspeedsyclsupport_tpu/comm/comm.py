@@ -352,15 +352,21 @@ def gather(x, axis_name, dst: int = 0):
     rank ``dst`` reads it, others may ignore it (XLA DCEs unused outputs).
     """
     del dst  # root semantics dissolve under SPMD; kept for API parity
-    return all_gather(x, axis_name)
+    return all_gather(x, axis_name, tiled=False)  # stacked [world, ...]
 
 
 def scatter(x, axis_name, src: int = 0):
     """Scatter rank ``src``'s leading-dim shards (reference
     ``comm.scatter``): input [world, ...] on ``src``; every rank returns
     its own [...] shard."""
-    _log("scatter", axis_name, x)
-    full = broadcast(x, axis_name, src=src)
+    full = broadcast(x, axis_name, src=src)  # broadcast logs the transfer
+    n = lax.axis_size(axis_name)
+    if full.shape[0] != n:
+        # dynamic_index_in_dim would CLAMP a short leading dim, silently
+        # delivering the wrong shard — reject like the reference does for a
+        # wrong-length scatter_list
+        raise ValueError(f"scatter input leading dim {full.shape[0]} != "
+                         f"axis size {n}")
     return lax.dynamic_index_in_dim(full, lax.axis_index(axis_name), 0,
                                     keepdims=False)
 
@@ -441,16 +447,26 @@ def monitored_barrier(timeout=None):
 
 
 def get_global_rank(group=None, group_rank: int = 0) -> int:
-    """Reference ``get_global_rank``: resolve a group-relative rank.
-    Groups here are mesh axes or :func:`new_group` rank lists."""
+    """Reference ``get_global_rank``: resolve a group-relative rank for a
+    :func:`new_group` rank list (``None`` = the world group, where group
+    rank == global rank). Mesh-axis groups need mesh coordinates — raise
+    rather than return a plausible-looking wrong rank."""
     if isinstance(group, _RankGroup):
         return group.ranks[group_rank]
-    return group_rank
+    if group is None:
+        return group_rank
+    raise TypeError(
+        f"get_global_rank needs a new_group() handle or None, got "
+        f"{group!r} — for mesh axes, derive ranks from the topology mesh "
+        f"coordinates instead")
 
 
 def get_world_group():
-    """Reference ``get_world_group``."""
-    return _RankGroup(tuple(range(get_world_size())))
+    """Reference ``get_world_group``. Rank domain: DEVICE ranks — the same
+    domain every collective src/dst in this module uses (a single
+    controller drives all local devices, so process ranks would make the
+    world group [0] while ranks 0..7 participate in collectives)."""
+    return _RankGroup(tuple(range(get_device_count())))
 
 
 def get_all_ranks_from_group(group=None):
@@ -490,7 +506,7 @@ def destroy_process_group(group=None):
     the world group, no-op for sub-groups."""
     global _INITIALIZED
     if group is None or isinstance(group, _RankGroup) and \
-            len(group.ranks) == get_world_size():
+            len(group.ranks) == get_device_count():
         try:
             jax.distributed.shutdown()
         except Exception:  # single-controller / already down

@@ -1,0 +1,183 @@
+"""Runtime utility surface (reference ``deepspeed/runtime/utils.py``).
+
+The functions user scripts actually import when porting: memory
+reporting, global-norm/clipping helpers, seeding, small conveniences.
+JAX shift: tensors are immutable, so the ``_``-suffixed in-place
+clippers return NEW trees (callers must rebind); device "cache" memory
+is XLA-managed, so ``empty_cache`` clears compilation caches and
+reports, rather than freeing, live buffers.
+"""
+import os
+import random
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.logging import log_dist
+# re-exports: the reference keeps these in runtime/utils.py too
+from ..parallel.pipeline import (partition_balanced,  # noqa: F401
+                                 partition_uniform)
+
+__all__ = [
+    "see_memory_usage", "memory_status", "get_ma_status", "empty_cache",
+    "set_random_seed", "ensure_directory_exists", "noop_decorator",
+    "call_to_str", "get_only_unique_item", "get_global_norm",
+    "get_global_norm_of_tensors", "get_grad_norm", "get_weight_norm",
+    "clip_grad_norm_", "clip_gradients", "clip_tensors_by_global_norm",
+    "partition_uniform", "partition_balanced", "get_inactive_params",
+]
+
+
+# ---------------------------------------------------------------- memory
+def get_ma_status(device=None) -> Dict[str, int]:
+    """Device memory stats (reference ``get_ma_status`` returns torch's
+    memory_allocated; here XLA's per-device stats dict)."""
+    dev = device or jax.devices()[0]
+    try:
+        return dict(dev.memory_stats() or {})
+    except Exception:
+        return {}
+
+
+def memory_status(msg: str = "", device=None) -> Dict[str, int]:
+    """Log + return device memory stats (reference ``memory_status``)."""
+    stats = get_ma_status(device)
+    used = stats.get("bytes_in_use", 0)
+    peak = stats.get("peak_bytes_in_use", used)
+    limit = stats.get("bytes_limit", 0)
+    log_dist(f"memory_status {msg}: in_use={used / 2**30:.2f}GB "
+             f"peak={peak / 2**30:.2f}GB limit={limit / 2**30:.2f}GB")
+    return stats
+
+
+def see_memory_usage(message: str, force: bool = False) -> None:
+    """Reference ``see_memory_usage``: device + host memory snapshot.
+    ``force=False`` is a no-op (same gating as the reference)."""
+    if not force:
+        return
+    stats = get_ma_status()
+    used = stats.get("bytes_in_use", 0)
+    peak = stats.get("peak_bytes_in_use", used)
+    try:
+        import resource
+
+        host_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+    except Exception:
+        host_mb = 0.0
+    log_dist(f"{message} | device MA {used / 2**30:.2f} GB, peak "
+             f"{peak / 2**30:.2f} GB | host RSS peak {host_mb / 1024:.2f} GB")
+
+
+def empty_cache() -> None:
+    """Reference ``empty_cache`` (torch.cuda.empty_cache). XLA owns device
+    allocation — live buffers free when their arrays drop — so this clears
+    the python-side compilation/dispatch caches, which is the reclaimable
+    part."""
+    jax.clear_caches()
+
+
+# ----------------------------------------------------------------- misc
+def set_random_seed(seed: int) -> None:
+    """Reference ``set_random_seed``: python + numpy. JAX randomness is
+    explicit-key (pass ``jax.random.PRNGKey(seed)`` to the engine/model);
+    there is deliberately no hidden global to seed."""
+    random.seed(seed)
+    np.random.seed(seed)
+
+
+def ensure_directory_exists(filename: str) -> None:
+    """Reference ``ensure_directory_exists`` — mkdir -p of the dirname."""
+    d = os.path.dirname(filename)
+    if d:
+        os.makedirs(d, exist_ok=True)
+
+
+def noop_decorator(func):
+    return func
+
+
+def call_to_str(base: str, *args, **kwargs) -> str:
+    """Reference ``call_to_str``: render a call for logging."""
+    name = f"{base}("
+    if args:
+        name += ", ".join(repr(arg) for arg in args)
+        if kwargs:
+            name += ", "
+    if kwargs:
+        name += ", ".join(f"{k}={v!r}" for k, v in kwargs.items())
+    return name + ")"
+
+
+def get_only_unique_item(items: Sequence) -> Any:
+    found = set(items)
+    if len(found) != 1:
+        raise RuntimeError(f"expected there to be only one unique element "
+                           f"in {items}")
+    return next(iter(found))
+
+
+def get_inactive_params(params) -> List:
+    """Reference ``get_inactive_params`` (ZeRO-3 NOT_AVAILABLE partitioned
+    params). GSPMD keeps every leaf logically available — sharded arrays
+    are never 'inactive' — so this is always empty, by design."""
+    return []
+
+
+# ------------------------------------------------------- norms / clipping
+def get_global_norm_of_tensors(tensors, norm_type: float = 2.0):
+    """Global norm over a list/pytree (reference
+    ``get_global_norm_of_tensors``)."""
+    leaves = jax.tree_util.tree_leaves(tensors)
+    if norm_type == 2.0:
+        import optax
+
+        return optax.global_norm(leaves)
+    stacked = jnp.concatenate([jnp.abs(l.ravel()) for l in leaves])
+    if norm_type == float("inf"):
+        return stacked.max()
+    return (stacked ** norm_type).sum() ** (1.0 / norm_type)
+
+
+def get_global_norm(norm_list: Sequence[float]):
+    """Reference ``get_global_norm``: combine pre-computed L2 norms."""
+    total = 0.0
+    for n in norm_list:
+        total += float(n) ** 2.0
+    return total ** 0.5
+
+
+def get_grad_norm(grads, norm_type: float = 2.0):
+    return get_global_norm_of_tensors(grads, norm_type)
+
+
+def get_weight_norm(params, norm_type: float = 2.0):
+    return get_global_norm_of_tensors(params, norm_type)
+
+
+def clip_tensors_by_global_norm(tensors, max_norm: float = 1.0,
+                                global_norm=None, eps: float = 1e-6):
+    """Scale a tree so its global norm is at most ``max_norm`` (reference
+    ``clip_tensors_by_global_norm``). Returns (new_tree, global_norm) —
+    immutable arrays mean the caller rebinds instead of mutating."""
+    if global_norm is None:
+        global_norm = get_global_norm_of_tensors(tensors)
+    scale = jnp.minimum(1.0, max_norm / (global_norm + eps))
+    return (jax.tree_util.tree_map(lambda t: t * scale, tensors),
+            global_norm)
+
+
+def clip_grad_norm_(parameters, max_norm: float, norm_type: float = 2.0):
+    """Reference ``clip_grad_norm_``: returns (clipped_tree, total_norm).
+    NOTE the JAX shift — arrays are immutable, so unlike torch this does
+    NOT mutate in place; rebind the result."""
+    norm = get_global_norm_of_tensors(parameters, norm_type)
+    clipped, _ = clip_tensors_by_global_norm(parameters, max_norm, norm)
+    return clipped, norm
+
+
+def clip_gradients(gradients, max_norm: float = 1.0):
+    """Reference ``clip_gradients``."""
+    clipped, norm = clip_grad_norm_(gradients, max_norm)
+    return clipped, norm

@@ -649,3 +649,60 @@ def test_pld_with_gradient_accumulation():
     for _ in range(2):
         m = engine.train_batch({"input_ids": ids})
     assert np.isfinite(float(np.asarray(m["loss"])))
+
+
+class TestRuntimeUtils:
+    """runtime/utils.py parity surface (reference deepspeed/runtime/utils.py
+    — the helpers ported user scripts import)."""
+
+    def test_global_norm_and_clipping(self):
+        from deepspeedsyclsupport_tpu.runtime.utils import (
+            clip_grad_norm_, clip_tensors_by_global_norm,
+            get_global_norm, get_global_norm_of_tensors)
+
+        tree = {"a": jnp.full((4,), 3.0), "b": jnp.full((9,), 4.0)}
+        n = float(get_global_norm_of_tensors(tree))
+        np.testing.assert_allclose(n, np.sqrt(4 * 9 + 9 * 16), rtol=1e-6)
+        clipped, norm = clip_grad_norm_(tree, max_norm=1.0)
+        assert float(norm) == pytest.approx(n)
+        np.testing.assert_allclose(
+            float(get_global_norm_of_tensors(clipped)), 1.0, rtol=1e-4)
+        # under the cap: untouched
+        same, _ = clip_tensors_by_global_norm(tree, max_norm=1e9)
+        np.testing.assert_allclose(np.asarray(same["a"]), 3.0)
+        assert get_global_norm([3.0, 4.0]) == pytest.approx(5.0)
+
+    def test_inf_norm(self):
+        from deepspeedsyclsupport_tpu.runtime.utils import (
+            get_global_norm_of_tensors)
+
+        tree = [jnp.array([1.0, -7.0]), jnp.array([2.0])]
+        assert float(get_global_norm_of_tensors(
+            tree, norm_type=float("inf"))) == 7.0
+
+    def test_misc_helpers(self, tmp_path):
+        from deepspeedsyclsupport_tpu.runtime.utils import (
+            call_to_str, ensure_directory_exists, get_inactive_params,
+            get_only_unique_item, memory_status, see_memory_usage,
+            set_random_seed)
+
+        ensure_directory_exists(str(tmp_path / "x" / "y" / "f.txt"))
+        assert (tmp_path / "x" / "y").is_dir()
+        assert call_to_str("f", 1, b=2) == "f(1, b=2)"
+        assert get_only_unique_item([3, 3, 3]) == 3
+        with pytest.raises(RuntimeError):
+            get_only_unique_item([1, 2])
+        set_random_seed(7)
+        a = np.random.rand()
+        set_random_seed(7)
+        assert np.random.rand() == a
+        assert get_inactive_params(object()) == []
+        see_memory_usage("test", force=True)  # logs, must not raise
+        assert isinstance(memory_status("test"), dict)
+
+    def test_partition_reexports(self):
+        from deepspeedsyclsupport_tpu.runtime.utils import (
+            partition_balanced, partition_uniform)
+
+        assert partition_uniform(8, 4) == [0, 2, 4, 6, 8]
+        assert partition_balanced([1, 1, 10, 1], 2)[1] in (2, 3)

@@ -241,4 +241,6 @@ class TestReferenceSurfaceParity:
         assert dist.get_all_ranks_from_group(g) == [2, 5, 7]
         assert dist.get_global_rank(g, 1) == 5
         assert g.size() == 3
-        assert dist.get_world_group().size() == dist.get_world_size()
+        assert dist.get_world_group().size() == dist.get_device_count()
+        with pytest.raises(TypeError):
+            dist.get_global_rank("model", 1)  # mesh axes need coordinates
