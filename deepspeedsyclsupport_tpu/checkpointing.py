@@ -1,0 +1,53 @@
+"""Import-path compat: ``deepspeed.checkpointing`` (reference
+``runtime/activation_checkpointing/checkpointing.py``).
+
+Under XLA, activation checkpointing is ``jax.checkpoint``; the config
+knobs (partition_activations, cpu_checkpointing, ...) map to checkpoint
+POLICIES selected via the engine's ``activation_checkpointing`` section
+(see runtime/config.py). This module keeps the reference's call surface
+for ported model code.
+"""
+from typing import Any, Callable
+
+import jax
+
+from .utils.logging import logger
+
+_CONFIGURED = False
+_POLICY = None
+
+
+def checkpoint(function: Callable, *args) -> Any:
+    """Reference ``checkpointing.checkpoint(fn, *args)``: run ``fn`` under
+    rematerialization. Returns fn's outputs; gradients recompute the
+    forward instead of saving activations."""
+    return jax.checkpoint(function, policy=_POLICY)(*args)
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None):
+    """Reference ``checkpointing.configure``. Partitioning/contiguity are
+    XLA's job under GSPMD; ``checkpoint_in_cpu`` selects the host-offload
+    remat policy (the cpu_checkpointing analog)."""
+    global _CONFIGURED, _POLICY
+    _CONFIGURED = True
+    if checkpoint_in_cpu:
+        _POLICY = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host")
+        logger.info("checkpointing.configure: dot activations offload to "
+                    "pinned host memory")
+    else:
+        _POLICY = None  # reconfiguration must clear a stale offload policy
+    return None
+
+
+def is_configured() -> bool:
+    """Reference ``checkpointing.is_configured``."""
+    return _CONFIGURED
+
+
+def reset():
+    global _CONFIGURED, _POLICY
+    _CONFIGURED = False
+    _POLICY = None

@@ -1,0 +1,34 @@
+"""Import-path compat: ``deepspeed.ops.adam`` (reference FusedAdam /
+DeepSpeedCPUAdam classes over CUDA/AVX kernels). Here both resolve to the
+XLA-fused optax chain the engine builds — construct and pass as the
+``optimizer`` argument to ``initialize`` or use standalone as an optax
+GradientTransformation factory."""
+from typing import Iterable, Optional, Tuple
+
+
+def _build(t: str, lr, betas, eps, weight_decay, adam_w_mode=True):
+    from ...runtime.optimizers import build_optimizer
+
+    params = {"lr": lr, "betas": list(betas), "eps": eps,
+              "weight_decay": weight_decay, "adam_w_mode": adam_w_mode}
+    return build_optimizer(t, params)
+
+
+def FusedAdam(params: Optional[Iterable] = None, lr: float = 1e-3,
+              betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-8,
+              weight_decay: float = 0.0, adam_w_mode: bool = True,
+              **_ignored):
+    """Reference ``FusedAdam`` (multi-tensor CUDA Adam) → the fused optax
+    transform (``params`` is unused: JAX optimizers bind at ``init``)."""
+    return _build("adam", lr, betas, eps, weight_decay, adam_w_mode)
+
+
+def DeepSpeedCPUAdam(model_params: Optional[Iterable] = None,
+                     lr: float = 1e-3,
+                     betas: Tuple[float, float] = (0.9, 0.999),
+                     eps: float = 1e-8, weight_decay: float = 0.0,
+                     adamw_mode: bool = True, **_ignored):
+    """Reference ``DeepSpeedCPUAdam`` (AVX host Adam for ZeRO-Offload) —
+    same math; host placement comes from the engine's offload config, not
+    the optimizer class."""
+    return _build("cpu_adam", lr, betas, eps, weight_decay, adamw_mode)

@@ -1,0 +1,8 @@
+"""Import-path compat: ``deepspeed.pipe`` (reference ``deepspeed/pipe/``
+re-exports ``PipelineModule``/schedules from ``runtime/pipe``). Ported
+scripts keep their imports; the SPMD pipeline semantics live in
+``parallel/pipeline.py``."""
+from .parallel.pipeline import (InferenceSchedule,  # noqa: F401
+                                PipelineModule, PipeSchedule,
+                                TrainSchedule, partition_balanced,
+                                partition_uniform, spmd_pipeline)

@@ -90,12 +90,13 @@ def build_optimizer(opt_type: str, params: Dict[str, Any],
             factor_min=float(params.get("factor_min", 0.5)),
             factor_threshold=float(params.get("factor_threshold", 0.1)))
 
-    if t in (ADAMW_OPTIMIZER, FUSED_ADAM, CPU_ADAM):
-        # reference FusedAdam defaults adam_w_mode=True → AdamW semantics
+    if t == ADAMW_OPTIMIZER:
         tx = optax.inject_hyperparams(optax.adamw)(
             learning_rate=schedule, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
-    elif t == ADAM_OPTIMIZER:
-        if params.get("adam_w_mode", True):
+    elif t in (ADAM_OPTIMIZER, FUSED_ADAM, CPU_ADAM):
+        # reference FusedAdam/CPUAdam default adam_w_mode=True → AdamW;
+        # adam_w_mode=False is classic Adam (no decoupled decay)
+        if params.get("adam_w_mode", params.get("adamw_mode", True)):
             tx = optax.inject_hyperparams(optax.adamw)(
                 learning_rate=schedule, b1=betas[0], b2=betas[1], eps=eps,
                 weight_decay=wd)

@@ -4,8 +4,7 @@ The functions user scripts actually import when porting: memory
 reporting, global-norm/clipping helpers, seeding, small conveniences.
 JAX shift: tensors are immutable, so the ``_``-suffixed in-place
 clippers return NEW trees (callers must rebind); device "cache" memory
-is XLA-managed, so ``empty_cache`` clears compilation caches and
-reports, rather than freeing, live buffers.
+is XLA-managed, so ``empty_cache`` is a documented no-op.
 """
 import os
 import random
@@ -71,11 +70,11 @@ def see_memory_usage(message: str, force: bool = False) -> None:
 
 
 def empty_cache() -> None:
-    """Reference ``empty_cache`` (torch.cuda.empty_cache). XLA owns device
-    allocation — live buffers free when their arrays drop — so this clears
-    the python-side compilation/dispatch caches, which is the reclaimable
-    part."""
-    jax.clear_caches()
+    """Reference ``empty_cache`` (torch.cuda.empty_cache). Deliberately a
+    no-op: XLA owns device allocation (live buffers free when their arrays
+    drop), and scripts call this inside training loops — clearing the jit
+    cache here would force a full recompile per call. To actually drop
+    compiled programs, call ``jax.clear_caches()`` yourself."""
 
 
 # ----------------------------------------------------------------- misc
@@ -126,9 +125,15 @@ def get_inactive_params(params) -> List:
 
 
 # ------------------------------------------------------- norms / clipping
-def get_global_norm_of_tensors(tensors, norm_type: float = 2.0):
+def get_global_norm_of_tensors(tensors, norm_type: float = 2.0,
+                               mpu=None, use_graph=False,
+                               moe_ep_group=None):
     """Global norm over a list/pytree (reference
-    ``get_global_norm_of_tensors``)."""
+    ``get_global_norm_of_tensors``). ``mpu``/groups are accepted for
+    signature parity and unused: norms over GLOBAL jax arrays already span
+    every shard, which is the whole job the reference's mpu reductions
+    do."""
+    del mpu, use_graph, moe_ep_group
     leaves = jax.tree_util.tree_leaves(tensors)
     if norm_type == 2.0:
         import optax
@@ -148,19 +153,22 @@ def get_global_norm(norm_list: Sequence[float]):
     return total ** 0.5
 
 
-def get_grad_norm(grads, norm_type: float = 2.0):
-    return get_global_norm_of_tensors(grads, norm_type)
+def get_grad_norm(grads, norm_type: float = 2.0, mpu=None):
+    return get_global_norm_of_tensors(grads, norm_type, mpu)
 
 
-def get_weight_norm(params, norm_type: float = 2.0):
-    return get_global_norm_of_tensors(params, norm_type)
+def get_weight_norm(params, norm_type: float = 2.0, mpu=None):
+    return get_global_norm_of_tensors(params, norm_type, mpu)
 
 
 def clip_tensors_by_global_norm(tensors, max_norm: float = 1.0,
-                                global_norm=None, eps: float = 1e-6):
+                                global_norm=None, mpu=None,
+                                eps: float = 1e-6):
     """Scale a tree so its global norm is at most ``max_norm`` (reference
     ``clip_tensors_by_global_norm``). Returns (new_tree, global_norm) —
-    immutable arrays mean the caller rebinds instead of mutating."""
+    immutable arrays mean the caller rebinds instead of mutating; ``mpu``
+    is signature parity only (global arrays make its reduction moot)."""
+    del mpu
     if global_norm is None:
         global_norm = get_global_norm_of_tensors(tensors)
     scale = jnp.minimum(1.0, max_norm / (global_norm + eps))
@@ -168,11 +176,12 @@ def clip_tensors_by_global_norm(tensors, max_norm: float = 1.0,
             global_norm)
 
 
-def clip_grad_norm_(parameters, max_norm: float, norm_type: float = 2.0):
+def clip_grad_norm_(parameters, max_norm: float, norm_type: float = 2.0,
+                    mpu=None):
     """Reference ``clip_grad_norm_``: returns (clipped_tree, total_norm).
     NOTE the JAX shift — arrays are immutable, so unlike torch this does
     NOT mutate in place; rebind the result."""
-    norm = get_global_norm_of_tensors(parameters, norm_type)
+    norm = get_global_norm_of_tensors(parameters, norm_type, mpu)
     clipped, _ = clip_tensors_by_global_norm(parameters, max_norm, norm)
     return clipped, norm
 

@@ -1,0 +1,66 @@
+"""Import-path compatibility shims: the module paths ported reference
+scripts import (``deepspeed.pipe``, ``deepspeed.moe.layer``,
+``deepspeed.ops.adam``, ``deepspeed.checkpointing``) must exist and
+resolve onto the TPU-native implementations."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+class TestCompatShims:
+    def test_pipe_module_path(self):
+        from deepspeedsyclsupport_tpu.pipe import (PipelineModule,
+                                                   TrainSchedule)
+        from deepspeedsyclsupport_tpu.parallel.pipeline import (
+            PipelineModule as Real)
+
+        assert PipelineModule is Real
+        assert len(list(TrainSchedule(4, 2, 0))) > 0
+
+    def test_ops_adam_builds_optax(self):
+        from deepspeedsyclsupport_tpu.ops.adam import (DeepSpeedCPUAdam,
+                                                       FusedAdam)
+
+        params = {"w": jnp.full((4,), 2.0)}
+        for factory in (FusedAdam, DeepSpeedCPUAdam):
+            tx = factory(lr=0.1, weight_decay=0.0)
+            st = tx.init(params)
+            g = {"w": jnp.ones((4,))}
+            upd, _ = tx.update(g, st, params)
+            # first adam step ≈ -lr * sign(g)
+            np.testing.assert_allclose(np.asarray(upd["w"]), -0.1,
+                                       rtol=1e-3)
+
+    def test_checkpointing_surface(self):
+        from deepspeedsyclsupport_tpu import checkpointing
+
+        checkpointing.reset()
+        assert not checkpointing.is_configured()
+        checkpointing.configure(partition_activations=True)
+        assert checkpointing.is_configured()
+
+        # remat must preserve gradients exactly
+        def f(x):
+            return jnp.sum(jnp.tanh(x) ** 2)
+
+        x = jnp.linspace(-1, 1, 8)
+        g_plain = jax.grad(f)(x)
+        g_ckpt = jax.grad(
+            lambda v: checkpointing.checkpoint(f, v))(x)
+        np.testing.assert_allclose(np.asarray(g_ckpt), np.asarray(g_plain),
+                                   rtol=1e-6)
+        checkpointing.reset()
+
+    def test_moe_layer_maps_to_config(self):
+        from deepspeedsyclsupport_tpu.models import build_model
+        from deepspeedsyclsupport_tpu.moe.layer import MoE
+
+        spec = MoE(hidden_size=64, num_experts=4, k=2, capacity_factor=1.5)
+        model = build_model("tiny", **spec.model_config_kwargs())
+        assert model.config.num_experts == 4
+        assert model.config.num_experts_per_tok == 2
+        params = model.init_params(jax.random.PRNGKey(0))
+        assert "moe" in jax.tree_util.tree_map(lambda x: 0,
+                                               params)["layers"]
