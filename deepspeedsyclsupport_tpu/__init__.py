@@ -27,7 +27,19 @@ __all__ = [
     "get_world_topology",
     "initialize",
     "init_inference",
+    "DeepSpeedTransformerLayer",
+    "DeepSpeedTransformerConfig",
 ]
+
+
+def __getattr__(name):
+    # top-level aliases the reference exports from deepspeed/__init__.py,
+    # resolved lazily so importing the package stays light
+    if name in ("DeepSpeedTransformerLayer", "DeepSpeedTransformerConfig"):
+        from .ops import transformer as _t
+
+        return getattr(_t, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def initialize(*args, **kwargs):

@@ -52,6 +52,12 @@ class EncoderConfig:
     norm_position: str = "post"       # bert/distilbert: post-LN; clip: pre-LN
     causal: bool = False              # clip text tower attends causally
     dtype: str = "float32"
+    # training-time dropout (applied by tower_forward when train=True and
+    # an rng is supplied). attn_dropout is applied to the ATTENTION OUTPUT
+    # (probs-dropout would defeat the flash kernel) — a documented
+    # approximation of the reference kernel's prob-space dropout.
+    hidden_dropout: float = 0.0
+    attn_dropout: float = 0.0
     # vision tower (0 => text tower)
     image_size: int = 0
     patch_size: int = 0
@@ -77,36 +83,49 @@ def _ln_params(d):
             "bias": jnp.zeros((d,), jnp.float32)}
 
 
-def tower_layer_params(cfg: EncoderConfig, rng) -> Params:
+def tower_layer_params(cfg: EncoderConfig, rng,
+                       std: float = 0.02) -> Params:
     d, f = cfg.hidden_size, cfg.intermediate_size
     ks = iter(jax.random.split(rng, 8))
     return {
-        "attn": {"wq": _dense(next(ks), (d, d)), "bq": jnp.zeros((d,)),
-                 "wk": _dense(next(ks), (d, d)), "bk": jnp.zeros((d,)),
-                 "wv": _dense(next(ks), (d, d)), "bv": jnp.zeros((d,)),
-                 "wo": _dense(next(ks), (d, d)), "bo": jnp.zeros((d,))},
+        "attn": {"wq": _dense(next(ks), (d, d), std), "bq": jnp.zeros((d,)),
+                 "wk": _dense(next(ks), (d, d), std), "bk": jnp.zeros((d,)),
+                 "wv": _dense(next(ks), (d, d), std), "bv": jnp.zeros((d,)),
+                 "wo": _dense(next(ks), (d, d), std), "bo": jnp.zeros((d,))},
         "attn_norm": _ln_params(d),
-        "mlp": {"fc1": _dense(next(ks), (d, f)), "b1": jnp.zeros((f,)),
-                "fc2": _dense(next(ks), (f, d)), "b2": jnp.zeros((d,))},
+        "mlp": {"fc1": _dense(next(ks), (d, f), std), "b1": jnp.zeros((f,)),
+                "fc2": _dense(next(ks), (f, d), std), "b2": jnp.zeros((d,))},
         "mlp_norm": _ln_params(d),
     }
 
 
 def tower_forward(cfg: EncoderConfig, layers: Params, x: jnp.ndarray,
-                  mask: Optional[jnp.ndarray]) -> jnp.ndarray:
+                  mask: Optional[jnp.ndarray],
+                  rng: Optional[jax.Array] = None,
+                  train: bool = False) -> jnp.ndarray:
     """Scan the stacked encoder layers over ``x [B,S,D]``.
 
     ``mask [B,S]``: 1 for valid tokens. Padding isolation rides the flash
     kernel's segment-id masking (pads form their own segment, so valid
     tokens never attend to them); outputs at pad rows are garbage the
-    caller must ignore — exactly the HF contract.
+    caller must ignore — exactly the HF contract. ``train=True`` with an
+    ``rng`` enables the config's dropout (BERT placement: inside each
+    sublayer, before the residual).
     """
     act = _act(cfg.activation)
     eps = cfg.layer_norm_eps
     seg = mask.astype(jnp.int32) if mask is not None else None
     b, s, d = x.shape
+    use_drop = bool(train and rng is not None
+                    and (cfg.hidden_dropout > 0 or cfg.attn_dropout > 0))
 
-    def attn_sub(p, h):
+    def drop(h, rate, key):
+        if not use_drop or rate <= 0:
+            return h
+        keep = jax.random.bernoulli(key, 1.0 - rate, h.shape)
+        return jnp.where(keep, h / (1.0 - rate), 0.0).astype(h.dtype)
+
+    def attn_sub(p, h, key=None):
         q = (jnp.einsum("bsd,dq->bsq", h, p["wq"])
              + p["bq"].astype(h.dtype))
         k = (jnp.einsum("bsd,dk->bsk", h, p["wk"])
@@ -117,27 +136,41 @@ def tower_forward(cfg: EncoderConfig, layers: Params, x: jnp.ndarray,
         k = k.reshape(b, s, cfg.num_heads, cfg.head_dim)
         v = v.reshape(b, s, cfg.num_heads, cfg.head_dim)
         o = attention(q, k, v, causal=cfg.causal, segment_ids=seg)
+        if key is not None:
+            o = drop(o, cfg.attn_dropout, jax.random.fold_in(key, 1))
         o = o.reshape(b, s, d)
-        return jnp.einsum("bsq,qd->bsd", o, p["wo"]) + p["bo"].astype(h.dtype)
+        o = jnp.einsum("bsq,qd->bsd", o, p["wo"]) + p["bo"].astype(h.dtype)
+        if key is not None:
+            o = drop(o, cfg.hidden_dropout, jax.random.fold_in(key, 2))
+        return o
 
-    def mlp_sub(p, h):
+    def mlp_sub(p, h, key=None):
         h = act(jnp.einsum("bsd,df->bsf", h, p["fc1"])
                 + p["b1"].astype(h.dtype))
-        return jnp.einsum("bsf,fd->bsd", h, p["fc2"]) + p["b2"].astype(h.dtype)
+        h = jnp.einsum("bsf,fd->bsd", h, p["fc2"]) + p["b2"].astype(h.dtype)
+        if key is not None:
+            h = drop(h, cfg.hidden_dropout, jax.random.fold_in(key, 3))
+        return h
 
     def ln(h, p):
         return layer_norm(h, p["scale"], p["bias"], eps)
 
-    def layer(h, p):
+    def layer(h, inp):
+        p, key = inp
         if cfg.norm_position == "post":       # bert: LN(x + sub(x))
-            h = ln(h + attn_sub(p["attn"], h), p["attn_norm"])
-            h = ln(h + mlp_sub(p["mlp"], h), p["mlp_norm"])
+            h = ln(h + attn_sub(p["attn"], h, key), p["attn_norm"])
+            h = ln(h + mlp_sub(p["mlp"], h, key), p["mlp_norm"])
         else:                                  # clip/vit: x + sub(LN(x))
-            h = h + attn_sub(p["attn"], ln(h, p["attn_norm"]))
-            h = h + mlp_sub(p["mlp"], ln(h, p["mlp_norm"]))
+            h = h + attn_sub(p["attn"], ln(h, p["attn_norm"]), key)
+            h = h + mlp_sub(p["mlp"], ln(h, p["mlp_norm"]), key)
         return h, None
 
-    x, _ = jax.lax.scan(layer, x, layers)
+    n_layers = jax.tree_util.tree_leaves(layers)[0].shape[0]
+    keys = (jax.random.split(rng, n_layers) if use_drop
+            else jnp.zeros((n_layers, 2), jnp.uint32))
+    if not use_drop:
+        keys = None
+    x, _ = jax.lax.scan(layer, x, (layers, keys))
     return x
 
 

@@ -1,0 +1,112 @@
+"""``DeepSpeedTransformerLayer`` — the BERT-era fused training layer.
+
+Reference: ``deepspeed/ops/transformer/transformer.py``
+(``DeepSpeedTransformerConfig`` / ``DeepSpeedTransformerLayer`` over the
+~8k-LoC ``csrc/transformer/*.cu`` fused kernels). On TPU the fusion those
+kernels provide (bias+gelu, bias+dropout+residual, fused softmax,
+stochastic mode) is XLA's job, so the module is a thin functional layer
+over the shared encoder tower (``models/encoder.py``) — one layer, pre- or
+post-LN per config, engine-protocol params.
+
+Config fields that configure CUDA-kernel internals
+(``normalize_invertible``, ``gelu_checkpoint``, ``attn_dropout_checkpoint``,
+``stochastic_mode``, memory/throughput trades) are accepted and recorded
+but have no TPU meaning — ``jax.checkpoint`` + XLA fusion subsume them.
+Dropout IS functional: pass ``rng`` to the call when training
+(``attn_dropout_ratio`` applies to the attention output — the prob-space
+variant would defeat the flash kernel).
+"""
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.encoder import EncoderConfig, tower_forward, tower_layer_params
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass
+class DeepSpeedTransformerConfig:
+    """Reference ``DeepSpeedTransformerConfig`` field surface."""
+    batch_size: int = -1
+    hidden_size: int = 768
+    intermediate_size: int = -1          # -1 => 4*hidden (reference default)
+    heads: int = 12
+    attn_dropout_ratio: float = 0.0
+    hidden_dropout_ratio: float = 0.0
+    num_hidden_layers: int = -1
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    local_rank: int = -1
+    seed: int = -1
+    fp16: bool = False
+    pre_layer_norm: bool = True
+    # CUDA-kernel internals: accepted, recorded, subsumed by XLA/remat
+    normalize_invertible: bool = False
+    gelu_checkpoint: bool = False
+    adjust_init_range: bool = True
+    attn_dropout_checkpoint: bool = False
+    stochastic_mode: bool = False
+    return_tuple: bool = False
+    training: bool = True
+
+    def __post_init__(self):
+        if self.intermediate_size in (-1, None):
+            self.intermediate_size = 4 * self.hidden_size
+
+
+class DeepSpeedTransformerLayer:
+    """One transformer encoder layer (reference
+    ``DeepSpeedTransformerLayer``), functional: ``init_params(rng)`` /
+    ``__call__(params, hidden_states, attention_mask)``.
+
+    ``hidden_states``: [B, S, H]; ``attention_mask``: [B, S] with 1 for
+    valid tokens (the HF convention the reference's ``huggingface`` flag
+    selects) — padding is isolated via segment masking in the shared
+    attention seam.
+    """
+
+    def __init__(self, config: DeepSpeedTransformerConfig,
+                 initial_weights=None, initial_biases=None):
+        if initial_weights is not None or initial_biases is not None:
+            raise NotImplementedError(
+                "initial_weights/initial_biases copy torch tensors into the "
+                "CUDA layer; load params via the HF/Megatron ingestion "
+                "loaders instead (checkpoint/hf.py)")
+        self.config = config
+        self._tower = EncoderConfig(
+            vocab_size=0,
+            hidden_size=config.hidden_size,
+            intermediate_size=config.intermediate_size,
+            num_layers=1,
+            num_heads=config.heads,
+            type_vocab_size=0,
+            layer_norm_eps=config.layer_norm_eps,
+            activation="gelu_exact",
+            norm_position="pre" if config.pre_layer_norm else "post",
+            hidden_dropout=config.hidden_dropout_ratio,
+            attn_dropout=config.attn_dropout_ratio,
+            dtype="bfloat16" if config.fp16 else "float32")
+
+    def init_params(self, rng: Optional[jax.Array] = None) -> Params:
+        rng = rng if rng is not None else jax.random.PRNGKey(
+            max(self.config.seed, 0))
+        p = tower_layer_params(self._tower, rng,
+                               std=self.config.initializer_range)
+        # stacked single-layer leaves: tower_forward scans the layer dim
+        return jax.tree_util.tree_map(lambda a: a[None], p)
+
+    def __call__(self, params: Params, hidden_states: jnp.ndarray,
+                 attention_mask: Optional[jnp.ndarray] = None,
+                 rng: Optional[jax.Array] = None):
+        """``rng`` enables the configured dropout (training); omit it for
+        deterministic eval — the reference's module training/eval mode."""
+        hidden_states = hidden_states.astype(jnp.dtype(self._tower.dtype))
+        out = tower_forward(self._tower, params, hidden_states,
+                            attention_mask, rng=rng,
+                            train=self.config.training and rng is not None)
+        return (out,) if self.config.return_tuple else out
+
+    apply = __call__

@@ -64,3 +64,85 @@ class TestCompatShims:
         params = model.init_params(jax.random.PRNGKey(0))
         assert "moe" in jax.tree_util.tree_map(lambda x: 0,
                                                params)["layers"]
+
+
+class TestDeepSpeedTransformerLayer:
+    """ops/transformer.py (reference DeepSpeedTransformerLayer over the
+    csrc/transformer CUDA kernels — here the shared encoder tower)."""
+
+    def test_post_ln_matches_bert_block(self):
+        """post-LN config must equal one layer of the BERT tower (the
+        arrangement BertForPreTraining + the reference layer share)."""
+        from deepspeedsyclsupport_tpu.models.encoder import (EncoderConfig,
+                                                             tower_forward)
+        from deepspeedsyclsupport_tpu.ops.transformer import (
+            DeepSpeedTransformerConfig, DeepSpeedTransformerLayer)
+
+        cfg = DeepSpeedTransformerConfig(hidden_size=32, heads=4,
+                                         intermediate_size=48,
+                                         pre_layer_norm=False)
+        layer = DeepSpeedTransformerLayer(cfg)
+        params = layer.init_params(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 32))
+        got = layer(params, x)
+        want = tower_forward(
+            EncoderConfig(vocab_size=0, hidden_size=32, num_heads=4,
+                          intermediate_size=48, type_vocab_size=0,
+                          layer_norm_eps=1e-12, activation="gelu_exact",
+                          norm_position="post"), params, x, None)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_pre_vs_post_differ_and_mask_isolates(self):
+        from deepspeedsyclsupport_tpu.ops.transformer import (
+            DeepSpeedTransformerConfig, DeepSpeedTransformerLayer)
+
+        x = jax.random.normal(jax.random.PRNGKey(2), (2, 8, 32))
+        outs = {}
+        for pre in (True, False):
+            layer = DeepSpeedTransformerLayer(DeepSpeedTransformerConfig(
+                hidden_size=32, heads=4, pre_layer_norm=pre))
+            p = layer.init_params(jax.random.PRNGKey(0))
+            outs[pre] = np.asarray(layer(p, x))
+        assert np.abs(outs[True] - outs[False]).max() > 1e-3
+        # padding isolation: changing a masked token leaves valid rows alone
+        layer = DeepSpeedTransformerLayer(DeepSpeedTransformerConfig(
+            hidden_size=32, heads=4))
+        p = layer.init_params(jax.random.PRNGKey(0))
+        mask = np.ones((2, 8), np.int32)
+        mask[:, -2:] = 0
+        x2 = np.asarray(x).copy()
+        x2[:, -1] += 100.0
+        a = np.asarray(layer(p, jnp.asarray(x), jnp.asarray(mask)))
+        b = np.asarray(layer(p, jnp.asarray(x2), jnp.asarray(mask)))
+        np.testing.assert_allclose(a[:, :6], b[:, :6], rtol=1e-5, atol=1e-5)
+
+    def test_default_intermediate_and_return_tuple(self):
+        from deepspeedsyclsupport_tpu.ops.transformer import (
+            DeepSpeedTransformerConfig, DeepSpeedTransformerLayer)
+
+        cfg = DeepSpeedTransformerConfig(hidden_size=32, heads=4,
+                                         return_tuple=True)
+        assert cfg.intermediate_size == 128
+        layer = DeepSpeedTransformerLayer(cfg)
+        p = layer.init_params(jax.random.PRNGKey(0))
+        out = layer(p, jnp.zeros((1, 4, 32)))
+        assert isinstance(out, tuple) and out[0].shape == (1, 4, 32)
+
+    def test_dropout_and_top_level_alias(self):
+        import deepspeedsyclsupport_tpu as deepspeed
+
+        cfg = deepspeed.DeepSpeedTransformerConfig(
+            hidden_size=32, heads=4, hidden_dropout_ratio=0.5,
+            initializer_range=0.01)
+        layer = deepspeed.DeepSpeedTransformerLayer(cfg)
+        p = layer.init_params(jax.random.PRNGKey(0))
+        # initializer_range reaches the weights
+        assert float(np.abs(np.asarray(
+            jax.tree_util.tree_leaves(p)[0])).std()) < 0.02
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 32))
+        eval_out = np.asarray(layer(p, x))
+        train_out = np.asarray(layer(p, x, rng=jax.random.PRNGKey(2)))
+        assert np.abs(eval_out - train_out).max() > 1e-4  # dropout active
+        # eval (no rng) is deterministic
+        np.testing.assert_array_equal(eval_out, np.asarray(layer(p, x)))
