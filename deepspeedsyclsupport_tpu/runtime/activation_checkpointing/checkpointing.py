@@ -1,0 +1,6 @@
+"""Import-path compat: ``deepspeed.runtime.activation_checkpointing.
+checkpointing`` — the reference exposes the checkpointing API at both this
+nested path and ``deepspeed.checkpointing``; both resolve to the same
+module here."""
+from ...checkpointing import (checkpoint, configure,  # noqa: F401
+                              is_configured, reset)
