@@ -89,13 +89,14 @@ def _tile_live(seg_q, seg_k, pos_q, pos_k, causal, window=None):
     return live
 
 
-def _bias(s, ab_ref, pos_q, pos_k, use_alibi):
+def _bias(s, ab_ref, head, pos_q, pos_k, use_alibi):
     """ALiBi logit bias ``slope·(k_pos − q_pos)`` (zero on the diagonal,
-    increasingly negative with distance); the per-head slope arrives as a
-    [1,1] SMEM scalar block."""
+    increasingly negative with distance); the [H,1] slope table sits whole
+    in SMEM (Mosaic rejects sub-(8,128) blocked windows even in SMEM) and
+    the kernel picks its head's scalar dynamically."""
     if not use_alibi:
         return s
-    return s + ab_ref[0, 0] * (pos_k - pos_q).astype(jnp.float32)
+    return s + ab_ref[head, 0] * (pos_k - pos_q).astype(jnp.float32)
 
 
 def _split_bias_refs(refs, n_fixed, has_bias, has_kbias, has_layout=False):
@@ -142,6 +143,7 @@ def _fwd_kernel(*refs, scale, causal, skip_offset, q_len, kv_len,
         refs[:-5], 8, has_bias, has_kbias, has_layout)
     q_ref, k_ref, v_ref, sq_ref, sk_ref, pq_ref, pk_ref, ab_ref = inputs
     o_ref, lse_ref, m_scr, l_scr, acc_scr = refs[-5:]
+    h = pl.program_id(1)  # hoisted: program_id must not sit inside pl.when
     i = pl.program_id(2)
     j = pl.program_id(3)
 
@@ -156,7 +158,7 @@ def _fwd_kernel(*refs, scale, causal, skip_offset, q_len, kv_len,
         k = k_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        s = _bias(s, ab_ref, pq_ref[0], pk_ref[0], use_alibi)
+        s = _bias(s, ab_ref, h, pq_ref[0], pk_ref[0], use_alibi)
         s = _add_biases(s, b_ref, kb_ref)
         mask = _mask(i, j, sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0],
                      causal=causal, q_len=q_len, kv_len=kv_len,
@@ -209,6 +211,7 @@ def _dq_kernel(*refs, scale, causal, skip_offset, q_len, kv_len,
         dq_ref, dbias_ref, dq_scr = refs[-3:]
     else:
         (dq_ref, dq_scr), dbias_ref = refs[-2:], None
+    h = pl.program_id(1)
     i = pl.program_id(2)
     j = pl.program_id(3)
 
@@ -223,7 +226,7 @@ def _dq_kernel(*refs, scale, causal, skip_offset, q_len, kv_len,
         do = do_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        s = _bias(s, ab_ref, pq_ref[0], pk_ref[0], use_alibi)
+        s = _bias(s, ab_ref, h, pq_ref[0], pk_ref[0], use_alibi)
         s = _add_biases(s, b_ref, kb_ref)
         mask = _mask(i, j, sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0],
                      causal=causal, q_len=q_len, kv_len=kv_len,
@@ -269,6 +272,7 @@ def _dkv_kernel(*refs, scale, causal, skip_offset, q_len, kv_len,
     (q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sq_ref, sk_ref,
      pq_ref, pk_ref, ab_ref) = inputs
     dk_ref, dv_ref, dk_scr, dv_scr = refs[-4:]
+    h = pl.program_id(1)
     j = pl.program_id(2)   # kv block (outer)
     i = pl.program_id(3)   # q block (inner, sequential accumulation)
 
@@ -284,7 +288,7 @@ def _dkv_kernel(*refs, scale, causal, skip_offset, q_len, kv_len,
         do = do_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        s = _bias(s, ab_ref, pq_ref[0], pk_ref[0], use_alibi)
+        s = _bias(s, ab_ref, h, pq_ref[0], pk_ref[0], use_alibi)
         s = _add_biases(s, b_ref, kb_ref)
         mask = _mask(i, j, sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0],
                      causal=causal, q_len=q_len, kv_len=kv_len,
@@ -318,7 +322,7 @@ def _dkv_kernel(*refs, scale, causal, skip_offset, q_len, kv_len,
 
 
 def _dbias_kernel(*refs, scale, causal, skip_offset, q_len, kv_len,
-                  block_q, block_k, num_replicas, use_alibi, window,
+                  block_q, block_k, num_replicas, rep_h, use_alibi, window,
                   has_kbias):
     """Reduced-dbias backward for BROADCAST pair biases: grid
     (bb, hb, i, j, r) with the replica axis r innermost-sequential, so the
@@ -333,6 +337,7 @@ def _dbias_kernel(*refs, scale, causal, skip_offset, q_len, kv_len,
     i = pl.program_id(2)
     j = pl.program_id(3)
     r = pl.program_id(4)
+    head = pl.program_id(1) * rep_h + r % rep_h
 
     @pl.when(r == 0)
     def _():
@@ -345,7 +350,7 @@ def _dbias_kernel(*refs, scale, causal, skip_offset, q_len, kv_len,
         do = do_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        s = _bias(s, ab_ref, pq_ref[0], pk_ref[0], use_alibi)
+        s = _bias(s, ab_ref, head, pq_ref[0], pk_ref[0], use_alibi)
         s = _add_biases(s, b_ref, kb_ref)
         mask = _mask(i, j, sq_ref[0], sk_ref[0], pq_ref[0], pk_ref[0],
                      causal=causal, q_len=q_len, kv_len=kv_len,
@@ -401,8 +406,7 @@ def _dbias_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab,
         pl.BlockSpec((1, 1, block_k), amap(lambda b, h, i, j: (b, 0, j))),
         pl.BlockSpec((1, block_q, 1), amap(lambda b, h, i, j: (b, i, 0))),
         pl.BlockSpec((1, 1, block_k), amap(lambda b, h, i, j: (b, 0, j))),
-        pl.BlockSpec((1, 1), lambda bi, hi, i, j, r: (hi * rh + r % rh, 0),
-                     memory_space=pltpu.SMEM),
+        _alibi_spec(),
         pl.BlockSpec((1, 1, block_q, block_k),
                      lambda bi, hi, i, j, r: (bi, hi, i, j)),
     ]
@@ -410,13 +414,13 @@ def _dbias_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab,
     if kbias is not None:
         kb = kbias.shape[0]
         in_specs.append(pl.BlockSpec(
-            (1, block_k),
-            amap(lambda b, h, i, j: (b * kb // (bb * rb), j))))
+            (1, 1, block_k),
+            amap(lambda b, h, i, j: (b * kb // (bb * rb), 0, j))))
         arrays.append(kbias)
     kern = functools.partial(
         _dbias_kernel, scale=scale, causal=causal, skip_offset=skip_offset,
         q_len=q_len, kv_len=kv_len, block_q=block_q, block_k=block_k,
-        num_replicas=nrep, use_alibi=use_alibi, window=window,
+        num_replicas=nrep, rep_h=rh, use_alibi=use_alibi, window=window,
         has_kbias=kbias is not None)
     return pl.pallas_call(
         kern,
@@ -435,8 +439,9 @@ def _dbias_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab,
 
 # ------------------------------------------------------------- pallas_call’s
 def _alibi_spec():
-    return pl.BlockSpec((1, 1), lambda b, h, i, j: (h, 0),
-                        memory_space=pltpu.SMEM)
+    # whole [H,1] table in SMEM: blocked SMEM windows below (8,128) fail
+    # Mosaic lowering, so the kernel indexes its head's slope dynamically
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
 def _bias_specs(bias, kbias, b, h, block_q, block_k, swap_ij=False):
@@ -460,9 +465,9 @@ def _bias_specs(bias, kbias, b, h, block_q, block_k, swap_ij=False):
         def kb_map(bi, hi, i, j):
             if swap_ij:
                 i, j = j, i
-            return (bi * kb // b, j)
+            return (bi * kb // b, 0, j)
 
-        specs.append(pl.BlockSpec((1, block_k), kb_map))
+        specs.append(pl.BlockSpec((1, 1, block_k), kb_map))
         arrays.append(kbias)
     return specs, arrays
 
@@ -603,8 +608,7 @@ def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab,
     sk_spec2 = pl.BlockSpec((1, 1, block_k), lambda b, h, j, i: (b, 0, j))
     dkv_out = pl.BlockSpec((1, 1, block_k, d),
                            lambda b, h, j, i: (b, h, j, 0))
-    ab_spec2 = pl.BlockSpec((1, 1), lambda b, h, j, i: (h, 0),
-                            memory_space=pltpu.SMEM)
+    ab_spec2 = _alibi_spec()
     b_specs2, b_arrays2 = _bias_specs(bias, kbias, b, h, block_q, block_k,
                                       swap_ij=True)
     if layout is not None:
@@ -804,9 +808,12 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         if k_bias.shape[1] != skv or b % k_bias.shape[0]:
             raise ValueError(f"k_bias shape {k_bias.shape} incompatible "
                              f"with kv ({b},{skv})")
-        kbias_p = jnp.pad(k_bias, ((0, 0), (0, skv_p - skv)))
+        # carried as [Bk, 1, Skv]: Mosaic requires the second-to-last block
+        # dim be 8-divisible or full — a batch window of 1 over Bk>1 is
+        # neither, so the batch axis must sit outside the last two dims
+        kbias_p = jnp.pad(k_bias, ((0, 0), (0, skv_p - skv)))[:, None, :]
     else:
-        kbias_p = jnp.zeros((1, 1), jnp.float32)  # unused placeholder
+        kbias_p = jnp.zeros((1, 1, 1), jnp.float32)  # unused placeholder
     if block_layout is not None:
         nq_b, nkv_b = sq_p // block_q, skv_p // block_k
         if (block_layout.ndim != 3 or block_layout.shape[0] not in (1, h)
