@@ -35,6 +35,16 @@ def constrain(x: jnp.ndarray, *spec) -> jnp.ndarray:
     topo = topo_mod._WORLD_TOPOLOGY
     if topo is None:
         return x
+    # inside a shard_map manual region (ZeRO++ explicit step, pipeline ring)
+    # a constraint naming manual axes is rejected at lowering — and the data
+    # is already placed per-shard there, so the constraint is meaningless
+    manual = set(getattr(jax.sharding.get_abstract_mesh(), "manual_axes",
+                         ()) or ())
+    if manual:
+        used = {a for s in spec
+                for a in (s if isinstance(s, (tuple, list)) else (s,)) if a}
+        if used & manual:
+            return x
     try:
         return jax.lax.with_sharding_constraint(x, topo.sharding(*spec))
     except (ValueError, TypeError):

@@ -102,7 +102,12 @@ def vocab_parallel_embedding(table, input_ids):
                  and input_ids.shape[1] % sizes.get("seq", 1) == 0
                  and table.shape[0] % tp == 0
                  and table.shape[1] % sizes.get("fsdp", 1) == 0)
-    if topo is None or tp == 1 or in_manual_region or not divisible:
+    # fsdp > 1 alone (stage-3 tables with no TP: hidden sharded over fsdp,
+    # e.g. the MiCS leg) also needs the explicit pattern — a plain take on
+    # the fsdp-sharded table makes the cotangent reshard "involuntary full
+    # rematerialization" in the partitioner
+    if topo is None or (tp == 1 and sizes.get("fsdp", 1) == 1) \
+            or in_manual_region or not divisible:
         return jnp.take(table, input_ids, axis=0)
 
     def body(tbl, ids):

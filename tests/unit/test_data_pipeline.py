@@ -168,8 +168,10 @@ class TestEngineIntegration:
                   for _ in range(5)]
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0]
-        # keep-count was scheduled upward and landed on the model config
-        assert model.config.random_ltd_current == 48
+        # keep-count was scheduled upward onto the ENGINE's model view
+        # (the caller's model object is never mutated)
+        assert engine.module.config.random_ltd_current == 48
+        assert model.config.random_ltd_current is None
 
     def test_random_ltd_full_keep_matches_dense(self):
         """keep >= S must be exactly the normal forward."""

@@ -214,15 +214,20 @@ def test_activation_checkpointing_config_drives_remat():
         "partition_activations": True, "policy": "dots_saveable"})
     cfg["train_batch_size"] = 16
     engine, _, _, _ = dstpu.initialize(model=model, config=cfg)
-    assert model.config.remat is True
-    assert model.config.remat_policy == "dots_saveable"
+    # overrides land on the engine's PRIVATE model view, never on the
+    # caller's model object (two engines may share one model)
+    assert engine.module.config.remat is True
+    assert engine.module.config.remat_policy == "dots_saveable"
+    assert model.config.remat is False
     # explicit "enabled": false turns remat OFF (the autotuner's off-arm
     # on a shared model object); mere partition_activations=false keeps it
     # ON, matching ported reference configs
     cfg_off = simple_config(activation_checkpointing={"enabled": False})
     cfg_off["train_batch_size"] = 16
-    dstpu.initialize(model=model, config=cfg_off)
-    assert model.config.remat is False
+    eng_off, _, _, _ = dstpu.initialize(model=model, config=cfg_off)
+    assert eng_off.module.config.remat is False
+    # ...and the first engine's view still has ITS configuration
+    assert engine.module.config.remat is True
     import jax
 
     ids = jax.random.randint(jax.random.PRNGKey(0), (16, 32), 0,
@@ -264,8 +269,8 @@ def test_cpu_checkpointing_offloads_activations():
     engine, *_ = dstpu.initialize(model=model, config=simple_config(
         activation_checkpointing={"partition_activations": True,
                                   "cpu_checkpointing": True}))
-    assert model.config.remat and \
-        model.config.remat_policy == "offload_dots_to_host"
+    assert engine.module.config.remat and \
+        engine.module.config.remat_policy == "offload_dots_to_host"
     ids = np.random.RandomState(0).randint(
         0, model.config.vocab_size,
         (engine.train_batch_size(), 16)).astype(np.int32)

@@ -251,7 +251,9 @@ class TestCausalLMPipeline:
             engine, _, _, _ = ds.initialize(model=model, params=params,
                                             config=config)
             assert engine.topology.axis_sizes["pipe"] == 2
-            assert model.config.pipe_microbatches == 2
+            # pipeline knobs land on the engine's private model view only
+            assert engine.module.config.pipe_microbatches == 2
+            assert model.config.pipe_microbatches is None
             losses = [float(engine.train_batch(batch)["loss"])
                       for _ in range(4)]
         finally:
