@@ -1,2 +1,10 @@
 from .logging import log_dist, logger
+from .tensor_fragment import (get_optimizer_state_keys, param_paths,
+                              resolve_param_path, safe_get_full_fp32_param,
+                              safe_get_full_grad,
+                              safe_get_full_optimizer_state,
+                              safe_get_local_fp32_param,
+                              safe_get_local_optimizer_state,
+                              safe_set_full_fp32_param,
+                              safe_set_full_optimizer_state)
 from .timer import SynchronizedWallClockTimer, ThroughputTimer

@@ -1,0 +1,350 @@
+"""Fragment-level parameter / optimizer-state access.
+
+TPU-native analog of the reference's ``deepspeed/utils/tensor_fragment.py``
+(+ ``mixed_precision_linkage.py``): debugging/introspection access to the
+fp32 master value, optimizer moments, and last gradient of any single
+parameter, regardless of which ZeRO stage / offload mode the engine runs —
+there, per-param ``tensor_fragment`` records map flat-partition offsets back
+to params; here, sharding is declarative (a leaf is one logical array with a
+``jax.sharding`` layout), so a "fragment" is just the addressable view of
+the leaf and the full value is ``jax.device_get`` of it.
+
+API parity (reference names, engine-scoped because JAX params are pytree
+leaves, not stateful tensors):
+
+==============================================  ================================
+reference (``utils/tensor_fragment.py``)          here
+==============================================  ================================
+``safe_get_full_fp32_param(p)``         :101      ``safe_get_full_fp32_param(engine, path)``
+``safe_set_full_fp32_param(p, v)``      :117      ``safe_set_full_fp32_param(engine, path, v)``
+``safe_get_full_optimizer_state(p, k)`` :133      ``safe_get_full_optimizer_state(engine, path, k)``
+``safe_set_full_optimizer_state``       :150      ``safe_set_full_optimizer_state(engine, path, v, k)``
+``safe_get_full_grad(p)``               :168      ``safe_get_full_grad(engine, path)``
+``safe_get_local_fp32_param``           :204      ``safe_get_local_fp32_param(engine, path)``
+``safe_get_local_optimizer_state``      :216      ``safe_get_local_optimizer_state(engine, path, k)``
+==============================================  ================================
+
+Optimizer-state keys use the reference's names (``exp_avg``/``exp_avg_sq``)
+and map onto whatever optax state the engine built (``mu``/``nu`` for the
+Adam family, ``mu`` for Lion/momentum, ``sum_of_squares`` for Adagrad);
+the optax field names are accepted as aliases.
+"""
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+__all__ = [
+    "safe_get_full_fp32_param", "safe_set_full_fp32_param",
+    "safe_get_full_optimizer_state", "safe_set_full_optimizer_state",
+    "safe_get_full_grad", "safe_get_local_fp32_param",
+    "safe_get_local_optimizer_state", "get_optimizer_state_keys",
+    "resolve_param_path", "param_paths",
+]
+
+# reference key -> optax field candidates, in preference order
+_KEY_ALIASES = {
+    "exp_avg": ("mu",),
+    "exp_avg_sq": ("nu",),
+    "momentum": ("mu", "trace"),
+    "sum": ("sum_of_squares",),
+}
+
+
+# ------------------------------------------------------------------ path utils
+def _split(path) -> Tuple[Any, ...]:
+    if isinstance(path, (tuple, list)):
+        return tuple(path)
+    return tuple(seg for seg in str(path).replace(".", "/").split("/") if seg)
+
+
+def param_paths(tree: Any) -> List[str]:
+    """All leaf paths of a params pytree as '/'-joined strings."""
+    out = []
+    for kp, _ in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        out.append("/".join(_key_str(k) for k in kp))
+    return out
+
+
+def _key_str(k) -> str:
+    if hasattr(k, "key"):
+        return str(k.key)
+    if hasattr(k, "idx"):
+        return str(k.idx)
+    if hasattr(k, "name"):
+        return str(k.name)
+    return str(k)
+
+
+def resolve_param_path(tree: Any, path) -> Any:
+    """Fetch the leaf at ``path`` ('/'- or '.'-separated, or a tuple)."""
+    node = tree
+    for seg in _split(path):
+        if isinstance(node, (list, tuple)):
+            node = node[int(seg)]
+        elif isinstance(node, dict):
+            if seg in node:
+                node = node[seg]
+            elif str(seg).isdigit() and int(seg) in node:
+                node = node[int(seg)]
+            else:
+                raise KeyError(
+                    f"path segment {seg!r} not found; available: "
+                    f"{list(node)[:12]}")
+        else:
+            node = getattr(node, str(seg))
+    return node
+
+
+def _replace_leaf(tree: Any, path, value: Any) -> None:
+    """In-place leaf replacement for dict/list pytrees (our params are plain
+    dicts; engines own their trees, so in-place is safe here)."""
+    segs = _split(path)
+    parent = resolve_param_path(tree, segs[:-1]) if len(segs) > 1 else tree
+    last = segs[-1]
+    if isinstance(parent, dict):
+        key = last if last in parent else int(last)
+        parent[key] = value
+    elif isinstance(parent, list):
+        parent[int(last)] = value
+    else:
+        setattr(parent, str(last), value)
+
+
+# ------------------------------------------------------- optimizer state walk
+def _adam_like_states(opt_state) -> List[Any]:
+    """Every element of the (possibly chained/nested) optax state that
+    carries per-param moment trees."""
+    found = []
+
+    def walk(node):
+        if node is None or isinstance(node, (int, float, np.ndarray,
+                                             jax.Array)):
+            return
+        fields = getattr(node, "_fields", None)
+        if fields:
+            if any(f in ("mu", "nu", "trace", "sum_of_squares")
+                   for f in fields):
+                found.append(node)
+            for f in fields:
+                walk(getattr(node, f))
+        elif isinstance(node, (tuple, list)):
+            for sub in node:
+                walk(sub)
+        elif isinstance(node, dict):
+            for sub in node.values():
+                walk(sub)
+
+    walk(opt_state)
+    return found
+
+
+def _moment_tree(engine, key: str) -> Tuple[Any, str]:
+    """(tree-of-moments, resolved optax field) for a reference-style key."""
+    opt_state = _materialized_opt_state(engine)
+    candidates = _KEY_ALIASES.get(key, ()) + (key,)
+    for st in _adam_like_states(opt_state):
+        for cand in candidates:
+            if cand in getattr(st, "_fields", ()):
+                return getattr(st, cand), cand
+    keys = get_optimizer_state_keys(engine)
+    raise KeyError(f"optimizer state key {key!r} not found; available: "
+                   f"{keys}")
+
+
+def get_optimizer_state_keys(engine) -> List[str]:
+    """Reference ``get_optim_state_keys``: the moment names this engine's
+    optimizer actually carries (reference naming where one exists)."""
+    rev = {"mu": "exp_avg", "nu": "exp_avg_sq", "sum_of_squares": "sum",
+           "trace": "momentum"}
+    if engine._mh_offload is not None:
+        return ["exp_avg", "exp_avg_sq"]
+    out = []
+    for st in _adam_like_states(_materialized_opt_state(engine)):
+        for f in st._fields:
+            if f in rev and rev[f] not in out:
+                out.append(rev[f])
+    return out
+
+
+def _materialized_opt_state(engine):
+    """The optax state tree, swapping in from NVMe if it is parked there."""
+    if engine.opt_state is None and engine._swapper is not None:
+        engine._swap_in_opt_state()
+    if engine.opt_state is None:
+        raise RuntimeError(
+            "engine has no materialized optimizer state (multi-host offload "
+            "keeps per-host shards — use the safe_get_local_* variants)")
+    return engine.opt_state
+
+
+def _master_tree(engine):
+    """Engine's fp32 authority tree: host master under offload, else the
+    (fp32) device params."""
+    if engine.master_params is not None:
+        return engine.master_params
+    return engine.params
+
+
+# ------------------------------------------------------------------- full API
+def safe_get_full_fp32_param(engine, path) -> np.ndarray:
+    """Full fp32 master value of one parameter (reference
+    ``safe_get_full_fp32_param``, ``utils/tensor_fragment.py:101``): gathered
+    across shards (a ``device_get`` on a sharded array assembles it), fetched
+    from the host master under ZeRO-Offload."""
+    if engine._mh_offload is not None:
+        raise RuntimeError(
+            "full-value access under multi-host offload needs a cross-host "
+            "gather — use safe_get_local_fp32_param on each controller")
+    leaf = resolve_param_path(_master_tree(engine), path)
+    return np.asarray(jax.device_get(leaf), np.float32)
+
+
+def safe_set_full_fp32_param(engine, path, value) -> None:
+    """Write a full fp32 master value back (reference :117). The device
+    working copy is refreshed so the next step sees the edit."""
+    if engine._mh_offload is not None:
+        raise RuntimeError("setting params under multi-host offload is not "
+                           "supported (each controller owns one shard)")
+    import jax.numpy as jnp
+
+    tree = _master_tree(engine)
+    old = resolve_param_path(tree, path)
+    value = np.asarray(value)
+    if value.shape != np.shape(old):
+        raise ValueError(f"shape mismatch: param {np.shape(old)} vs value "
+                         f"{value.shape}")
+    if engine.master_params is not None:
+        # host master is the authority; device params mirror in compute dtype
+        new_master = jax.device_put(value.astype(np.float32),
+                                    engine._cpu_device)
+        _replace_leaf(engine.master_params, path, new_master)
+        sh = resolve_param_path(engine.param_shardings, path)
+        dev = jax.device_put(value.astype(engine.compute_dtype), sh)
+        _replace_leaf(engine.params, path, dev)
+    else:
+        sh = resolve_param_path(engine.param_shardings, path)
+        new = jax.device_put(value.astype(np.asarray(old).dtype), sh)
+        _replace_leaf(engine.params, path, new)
+
+
+def safe_get_full_optimizer_state(engine, path, key: str) -> np.ndarray:
+    """Full value of one optimizer moment (reference :133); ``key`` is
+    ``exp_avg`` / ``exp_avg_sq`` (or an optax field name)."""
+    if engine._mh_offload is not None:
+        raise RuntimeError(
+            "full-value access under multi-host offload needs a cross-host "
+            "gather — use safe_get_local_optimizer_state on each controller")
+    tree, _ = _moment_tree(engine, key)
+    return np.asarray(jax.device_get(resolve_param_path(tree, path)))
+
+
+def safe_set_full_optimizer_state(engine, path, value, key: str) -> None:
+    """Write one optimizer moment back (reference :150). The new value is
+    placed with the old leaf's sharding/device, so stage placement is
+    preserved; under NVMe offload the edited state is re-parked."""
+    if engine._mh_offload is not None:
+        raise RuntimeError("setting optimizer state under multi-host offload "
+                           "is not supported")
+    tree, _ = _moment_tree(engine, key)
+    old = resolve_param_path(tree, path)
+    value = np.asarray(value, np.asarray(old).dtype)
+    if value.shape != np.shape(old):
+        raise ValueError(f"shape mismatch: state {np.shape(old)} vs value "
+                         f"{value.shape}")
+    placement = getattr(old, "sharding", None) or getattr(
+        engine, "_cpu_device", None)
+    placed = jax.device_put(value, placement) if placement is not None \
+        else value
+    _replace_leaf(tree, path, placed)
+    if engine._swapper is not None:
+        engine._swap_out_opt_state()
+
+
+def set_optimizer_step(engine, step: int) -> None:
+    """Set every optax ``count`` leaf (Adam bias-correction step) to
+    ``step`` — needed when optimizer moments are imported from an external
+    checkpoint so the next update applies the right bias correction."""
+    import jax.numpy as jnp
+
+    opt_state = _materialized_opt_state(engine)
+
+    def rebuild(node):
+        if hasattr(node, "_fields"):
+            vals = {}
+            for f in node._fields:
+                v = getattr(node, f)
+                if f == "count":
+                    vals[f] = jax.tree_util.tree_map(
+                        lambda c: jnp.full_like(c, step), v)
+                else:
+                    vals[f] = rebuild(v)
+            return type(node)(**vals)
+        if isinstance(node, tuple):
+            return tuple(rebuild(s) for s in node)
+        if isinstance(node, list):
+            return [rebuild(s) for s in node]
+        if isinstance(node, dict):
+            return {k: rebuild(v) for k, v in node.items()}
+        return node
+
+    engine.opt_state = rebuild(opt_state)
+    if engine._swapper is not None:
+        engine._swap_out_opt_state()
+
+
+def safe_get_full_grad(engine, path) -> Optional[np.ndarray]:
+    """Most recent accumulated fp32 gradient of a param (reference :168).
+    Only the eager ``forward()/backward()`` loop retains gradients between
+    calls; the fused ``train_batch()`` consumes them inside one jitted scan
+    (they never materialize engine-side) — returns None there, like the
+    reference returns None outside the grad-valid window."""
+    acc = getattr(engine, "_accum_grads", None)
+    if acc is None:
+        return None
+    return np.asarray(jax.device_get(resolve_param_path(acc, path)),
+                      np.float32)
+
+
+# ------------------------------------------------------------------ local API
+def _local_shard(arr) -> np.ndarray:
+    shards = getattr(arr, "addressable_shards", None)
+    if not shards:
+        return np.asarray(jax.device_get(arr))
+    return np.asarray(shards[0].data)
+
+
+def safe_get_local_fp32_param(engine, path) -> np.ndarray:
+    """This controller's shard of the fp32 master (reference
+    ``safe_get_local_fp32_param:204`` — the ZeRO-3 'local' view)."""
+    if engine._mh_offload is not None:
+        shards = engine._mh_offload.master[_mh_leaf_index(engine, path)]
+        return np.asarray(next(iter(shards.values())), np.float32)
+    return _local_shard(resolve_param_path(_master_tree(engine), path)) \
+        .astype(np.float32)
+
+
+def safe_get_local_optimizer_state(engine, path, key: str) -> np.ndarray:
+    """This controller's shard of one optimizer moment (reference :216)."""
+    if engine._mh_offload is not None:
+        store = {"exp_avg": engine._mh_offload.m, "mu": engine._mh_offload.m,
+                 "exp_avg_sq": engine._mh_offload.v,
+                 "nu": engine._mh_offload.v}.get(key)
+        if store is None:
+            raise KeyError(f"multi-host CPU Adam carries exp_avg/exp_avg_sq "
+                           f"only; got {key!r}")
+        shards = store[_mh_leaf_index(engine, path)]
+        return np.asarray(next(iter(shards.values())), np.float32)
+    tree, _ = _moment_tree(engine, key)
+    return _local_shard(resolve_param_path(tree, path))
+
+
+def _mh_leaf_index(engine, path) -> int:
+    """Flat leaf index of ``path`` (MultiHostCPUAdam stores per-leaf shard
+    dicts in params tree_flatten order)."""
+    leaves = jax.tree_util.tree_flatten_with_path(engine.params)[0]
+    want = tuple(str(s) for s in _split(path))
+    for i, (kp, _) in enumerate(leaves):
+        if tuple(_key_str(k) for k in kp) == want:
+            return i
+    raise KeyError(f"param path {path!r} not found")
