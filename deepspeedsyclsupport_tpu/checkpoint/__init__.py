@@ -1,3 +1,5 @@
+from .ds_import import (DeepSpeedCheckpoint,  # noqa: F401
+                        load_deepspeed_checkpoint)
 from .engine import load_tree, save_tree  # noqa: F401
 from .hf import (HFCheckpointSource, config_from_hf,  # noqa: F401
                  load_hf_checkpoint)

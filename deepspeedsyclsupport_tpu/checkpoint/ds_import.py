@@ -1,0 +1,359 @@
+"""Reference-format DeepSpeed checkpoint importer.
+
+The migration story for existing DeepSpeed users: read a checkpoint written
+by the reference engine (``deepspeed/runtime/engine.py:3050`` save layout —
+``latest`` tag file, ``mp_rank_XX_model_states.pt`` module files, per-DP-rank
+``*zero_pp_rank_{dp}_mp_rank_{tp}_optim_states.pt`` ZeRO shards) straight
+into this framework's engine state: fp32 master params, Adam moments, and
+step counters.
+
+The ZeRO shard reconstruction follows the reference's own offline merge
+protocol (``deepspeed/utils/zero_to_fp32.py:256,390`` and
+``checkpoint/ds_to_universal.py:87``):
+
+* stage <= 2 — each param group is ONE flat fp32 buffer partitioned
+  contiguously across DP ranks: concatenate rank partitions, then slice
+  sequentially by the ``param_shapes`` ordered dict saved in the module
+  file (trailing 2·world alignment padding tolerated).
+* stage 3 — params are interleaved: every param occupies
+  ``ceil(numel/world)`` elements at a COMMON offset in every rank's flat
+  buffer; zip the rank narrows and drop the tail padding.
+
+Adam moments (``base_optimizer_state``) use the same layouts and merge the
+same way. Torch pickles inside real checkpoints may reference deepspeed
+classes (loss scalers, fragment addresses); minimal unpickle shims are
+installed so ``torch.load`` succeeds without deepspeed present.
+"""
+import glob
+import os
+import re
+import sys
+import types
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.logging import log_dist, logger
+
+LATEST = "latest"
+MODEL_SUFFIX = "_model_states.pt"
+OPTIM_SUFFIX = "_optim_states.pt"
+
+# reference checkpoint/constants.py key names
+OPTIMIZER_STATE_DICT = "optimizer_state_dict"
+SINGLE_PARTITION = "single_partition_of_fp32_groups"
+FP32_FLAT_GROUPS = "fp32_flat_groups"
+BASE_OPTIMIZER_STATE = "base_optimizer_state"
+PARAM_SHAPES = "param_shapes"
+PARTITION_COUNT = "partition_count"
+ZERO_STAGE_KEY = "zero_stage"
+
+
+def _install_unpickle_shims() -> None:
+    """Stub the deepspeed classes reference pickles may name, so torch.load
+    of a real checkpoint works without deepspeed installed."""
+    try:
+        import deepspeed  # noqa: F401 — real package present, nothing to do
+
+        return
+    except ImportError:
+        pass
+
+    class _Stub:
+        def __init__(self, *a, **k):
+            self.__dict__.update(k)
+
+        def __setstate__(self, state):
+            if isinstance(state, dict):
+                self.__dict__.update(state)
+
+    shims = {
+        "deepspeed.runtime.fp16.loss_scaler": ["LossScaler",
+                                               "DynamicLossScaler"],
+        "deepspeed.utils.tensor_fragment": ["fragment_address",
+                                            "tensor_fragment"],
+        "deepspeed.runtime.zero.config": ["ZeroStageEnum"],
+    }
+    if "deepspeed" not in sys.modules:
+        sys.modules["deepspeed"] = types.ModuleType("deepspeed")
+    for mod_name, classes in shims.items():
+        parts = mod_name.split(".")
+        for i in range(2, len(parts) + 1):
+            name = ".".join(parts[:i])
+            if name not in sys.modules:
+                sys.modules[name] = types.ModuleType(name)
+        mod = sys.modules[mod_name]
+        for cls in classes:
+            if not hasattr(mod, cls):
+                setattr(mod, cls, type(cls, (_Stub,), {}))
+
+
+def _torch_load(path: str):
+    import torch
+
+    _install_unpickle_shims()
+    return torch.load(path, map_location="cpu", weights_only=False)
+
+
+def _to_np(t) -> np.ndarray:
+    import torch
+
+    if isinstance(t, torch.Tensor):
+        t = t.detach().cpu()
+        if t.dtype == torch.bfloat16:
+            t = t.float()
+        return t.numpy()
+    return np.asarray(t)
+
+
+class DeepSpeedCheckpoint:
+    """Inspector over a reference-format checkpoint directory (analog of
+    ``deepspeed/checkpoint/deepspeed_checkpoint.py:1``)."""
+
+    def __init__(self, ckpt_dir: str, tag: Optional[str] = None):
+        self.root = ckpt_dir
+        if tag is None:
+            latest = os.path.join(ckpt_dir, LATEST)
+            if not os.path.exists(latest):
+                raise FileNotFoundError(
+                    f"{latest} missing — pass tag= explicitly (reference "
+                    f"'latest' tag-pointer protocol)")
+            with open(latest) as f:
+                tag = f.read().strip()
+        self.tag = tag
+        self.dir = os.path.join(ckpt_dir, tag)
+        if not os.path.isdir(self.dir):
+            raise FileNotFoundError(f"no checkpoint directory {self.dir}")
+        self.model_files = sorted(glob.glob(
+            os.path.join(self.dir, f"mp_rank_*{MODEL_SUFFIX}")))
+        if not self.model_files:
+            raise FileNotFoundError(
+                f"no mp_rank_*{MODEL_SUFFIX} under {self.dir}")
+        if glob.glob(os.path.join(self.dir, "layer_*")):
+            raise NotImplementedError(
+                "pipeline-partitioned (layer_*) reference checkpoints are "
+                "not supported; consolidate with the reference's "
+                "ds_to_universal first")
+        self.optim_files = sorted(glob.glob(
+            os.path.join(self.dir, f"*zero_pp_rank_*{OPTIM_SUFFIX}")))
+        self.tp_degree = len(self.model_files)
+        self._model_sd = [_torch_load(f) for f in self.model_files]
+        self._optim_sd: Optional[List[Dict]] = None
+
+    # ------------------------------------------------------------ module side
+    def module_state_dict(self, tp_rank: int = 0) -> Dict[str, np.ndarray]:
+        """The saved module weights (compute precision) of one TP rank."""
+        return {k: _to_np(v)
+                for k, v in self._model_sd[tp_rank]["module"].items()}
+
+    @property
+    def param_shapes(self) -> List[Dict[str, tuple]]:
+        shapes = self._model_sd[0].get(PARAM_SHAPES)
+        if shapes is None:
+            raise ValueError(
+                "checkpoint carries no param_shapes — written by a "
+                "pre-0.3 DeepSpeed? (reference parse_model_states "
+                "requirement)")
+        if isinstance(shapes, dict):
+            shapes = [shapes]
+        return [{k: tuple(v) for k, v in group.items()} for group in shapes]
+
+    @property
+    def global_steps(self) -> int:
+        return int(self._model_sd[0].get("global_steps", 0) or 0)
+
+    @property
+    def ds_version(self) -> Optional[str]:
+        return self._model_sd[0].get("ds_version")
+
+    # -------------------------------------------------------------- zero side
+    def _load_optim(self) -> List[Dict]:
+        if self._optim_sd is None:
+            if self.tp_degree > 1:
+                raise NotImplementedError(
+                    "ZeRO import with TP-partitioned module files needs "
+                    "per-architecture merge rules; consolidate with the "
+                    "reference's ds_to_universal first")
+            self._optim_sd = [_torch_load(f)[OPTIMIZER_STATE_DICT]
+                              for f in self.optim_files]
+        return self._optim_sd
+
+    @property
+    def zero_stage(self) -> int:
+        if not self.optim_files:
+            return 0
+        return int(self._load_optim()[0].get(ZERO_STAGE_KEY, 1))
+
+    @property
+    def dp_degree(self) -> int:
+        if not self.optim_files:
+            return 1
+        pc = self._load_optim()[0].get(PARTITION_COUNT, len(self.optim_files))
+        return max(pc) if isinstance(pc, (list, tuple)) else int(pc)
+
+    def _flat_groups(self, key_chain: Callable[[Dict], List]) -> List[List]:
+        """Per-rank list of per-group flat buffers via ``key_chain(sd)``."""
+        return [key_chain(sd) for sd in self._load_optim()]
+
+    def _merge_stage2(self, per_rank_groups: List[List]) -> Dict[str, np.ndarray]:
+        """Contiguous-partition merge (zero_to_fp32.py:256)."""
+        out: Dict[str, np.ndarray] = {}
+        n_groups = len(per_rank_groups[0])
+        for g in range(n_groups):
+            flat = np.concatenate([_to_np(r[g]).astype(np.float32).ravel()
+                                   for r in per_rank_groups])
+            offset = 0
+            for name, shape in self.param_shapes[g].items():
+                n = int(np.prod(shape)) if shape else 1
+                out[name] = flat[offset:offset + n].reshape(shape)
+                offset += n
+            # trailing alignment padding (<= 2*world) is legal; more means
+            # the shapes don't describe this buffer
+            world = len(per_rank_groups)
+            align = 2 * world
+            if -(-offset // align) * align != -(-len(flat) // align) * align:
+                raise ValueError(
+                    f"group {g}: consumed {offset} of {len(flat)} elements "
+                    f"— param_shapes do not match the flat partitions")
+        return out
+
+    def _merge_stage3(self, per_rank_flat: List[np.ndarray]
+                      ) -> Dict[str, np.ndarray]:
+        """Interleaved-partition merge (zero_to_fp32.py:390)."""
+        world = len(per_rank_flat)
+        shapes = {k: v for group in self.param_shapes
+                  for k, v in group.items()}
+        out: Dict[str, np.ndarray] = {}
+        offset = 0
+        for name, shape in shapes.items():
+            n = int(np.prod(shape)) if shape else 1
+            per = -(-n // world)  # ceil: every rank holds `per`, padded
+            full = np.concatenate([r[offset:offset + per]
+                                   for r in per_rank_flat])
+            out[name] = full[:n].reshape(shape)
+            offset += per
+        return out
+
+    def fp32_state_dict(self) -> Dict[str, np.ndarray]:
+        """Merged full fp32 master weights (the zero_to_fp32 product)."""
+        if not self.optim_files:
+            return {k: v.astype(np.float32)
+                    for k, v in self.module_state_dict().items()}
+        stage = self.zero_stage
+        if stage <= 2:
+            groups = self._flat_groups(lambda sd: sd[SINGLE_PARTITION])
+            return self._merge_stage2(groups)
+        flats = [np.concatenate([_to_np(t).astype(np.float32).ravel()
+                                 for t in sd[FP32_FLAT_GROUPS]])
+                 for sd in self._load_optim()]
+        return self._merge_stage3(flats)
+
+    def optimizer_moments(self) -> Dict[str, Dict[str, np.ndarray]]:
+        """{'exp_avg': {name: arr}, 'exp_avg_sq': {name: arr}} merged the
+        same way the fp32 weights are."""
+        if not self.optim_files:
+            return {}
+        optim = self._load_optim()
+        base = optim[0].get(BASE_OPTIMIZER_STATE)
+        if not base:
+            return {}
+        out: Dict[str, Dict[str, np.ndarray]] = {}
+        stage = self.zero_stage
+        for key in ("exp_avg", "exp_avg_sq"):
+            try:
+                if stage <= 2:
+                    per_rank = []
+                    for sd in optim:
+                        b = sd[BASE_OPTIMIZER_STATE]
+                        groups = (b["state"] if isinstance(b, dict)
+                                  and "state" in b else b)
+                        if isinstance(groups, dict):
+                            groups = [groups[k] for k in sorted(groups)]
+                        per_rank.append([g[key] for g in groups])
+                    out[key] = self._merge_stage2(per_rank)
+                else:
+                    flats = []
+                    for sd in optim:
+                        b = sd[BASE_OPTIMIZER_STATE]
+                        groups = (b["state"] if isinstance(b, dict)
+                                  and "state" in b else b)
+                        if isinstance(groups, dict):
+                            groups = [groups[k] for k in sorted(groups)]
+                        flats.append(np.concatenate(
+                            [_to_np(g[key]).astype(np.float32).ravel()
+                             for g in groups]))
+                    out[key] = self._merge_stage3(flats)
+            except (KeyError, TypeError) as e:
+                logger.warning("moment %s not importable (%s) — optimizer "
+                               "state starts fresh", key, e)
+        return out
+
+
+def default_name_map(torch_name: str) -> str:
+    """torch dotted module path → our '/'-separated pytree path."""
+    return torch_name.replace(".", "/")
+
+
+def load_deepspeed_checkpoint(engine, load_dir: str,
+                              tag: Optional[str] = None,
+                              name_map: Optional[Callable[[str], str]] = None,
+                              load_optimizer_states: bool = True,
+                              strict: bool = True) -> str:
+    """Import a reference-format checkpoint into a live engine
+    (the migration analog of ``engine.load_checkpoint``,
+    reference ``runtime/engine.py:2688``).
+
+    ``name_map(torch_name) -> engine param path`` (default: dots→slashes;
+    return None to skip a tensor). Returns the resolved tag."""
+    from ..utils.tensor_fragment import (param_paths,
+                                         safe_set_full_fp32_param,
+                                         safe_set_full_optimizer_state)
+
+    ckpt = DeepSpeedCheckpoint(load_dir, tag)
+    nm = name_map or default_name_map
+    known = set(param_paths(engine.params))
+    fp32 = ckpt.fp32_state_dict()
+    mapped: Dict[str, np.ndarray] = {}
+    skipped: List[str] = []
+    for name, arr in fp32.items():
+        path = nm(name)
+        if path is None:
+            continue
+        if path not in known:
+            skipped.append(name)
+            continue
+        mapped[path] = arr
+    if skipped and strict:
+        raise KeyError(
+            f"{len(skipped)} checkpoint tensors have no engine param "
+            f"(first: {skipped[:4]}); pass name_map= or strict=False")
+    missing = known - set(mapped)
+    if missing and strict:
+        raise KeyError(f"{len(missing)} engine params absent from the "
+                       f"checkpoint (first: {sorted(missing)[:4]})")
+    for path, arr in mapped.items():
+        safe_set_full_fp32_param(engine, path, arr)
+    n_moments = 0
+    if load_optimizer_states:
+        moments = ckpt.optimizer_moments()
+        for key, tree in moments.items():
+            for name, arr in tree.items():
+                path = nm(name)
+                if path in mapped:
+                    safe_set_full_optimizer_state(engine, path, arr, key)
+                    n_moments += 1
+        if moments and ckpt.global_steps:
+            # Adam bias correction must resume at the checkpoint's step
+            from ..utils.tensor_fragment import set_optimizer_step
+
+            set_optimizer_step(engine, ckpt.global_steps)
+    engine.global_steps = ckpt.global_steps
+    log_dist(f"imported DeepSpeed checkpoint {ckpt.dir} "
+             f"(ds_version={ckpt.ds_version}, zero_stage={ckpt.zero_stage}, "
+             f"dp={ckpt.dp_degree}, {len(mapped)} params, "
+             f"{n_moments} moment tensors, step={ckpt.global_steps})")
+    return ckpt.tag
+
+
+__all__ = ["DeepSpeedCheckpoint", "load_deepspeed_checkpoint",
+           "default_name_map"]

@@ -1,0 +1,250 @@
+"""Reference-format DeepSpeed checkpoint import (VERDICT r3 #3).
+
+Fixtures are written in the reference's EXACT on-disk layout
+(``deepspeed/runtime/engine.py:3050`` save protocol: ``latest`` tag file,
+``mp_rank_00_model_states.pt``, ``{bf16_,}zero_pp_rank_{dp}_mp_rank_00_
+optim_states.pt`` with flat fp32 partitions + base Adam state), then
+imported into a live engine — ending with loss parity against the engine
+whose state the fixture encodes."""
+import os
+
+import numpy as np
+import pytest
+import torch
+
+import deepspeedsyclsupport_tpu as dstpu
+from deepspeedsyclsupport_tpu.checkpoint.ds_import import (
+    DeepSpeedCheckpoint, load_deepspeed_checkpoint)
+from deepspeedsyclsupport_tpu.utils import (safe_get_full_fp32_param,
+                                            safe_get_full_optimizer_state)
+
+from .simple_model import SimpleModel, random_dataset, simple_config
+
+
+def _flat_names_and_shapes(tree, prefix=""):
+    """Dotted torch-style names in deterministic order."""
+    out = []
+    for k in sorted(tree):
+        v = tree[k]
+        name = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.extend(_flat_names_and_shapes(v, name))
+        else:
+            out.append((name, np.asarray(v)))
+    return out
+
+
+def write_reference_checkpoint(root, tag, named, *, zero_stage, dp,
+                               moments=None, global_steps=7,
+                               module_dtype=np.float32):
+    """Write a checkpoint exactly as the reference engine lays it out."""
+    d = os.path.join(root, tag)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(root, "latest"), "w") as f:
+        f.write(tag)
+    module = {n: torch.from_numpy(a.astype(module_dtype)) for n, a in named}
+    param_shapes = [{n: torch.Size(a.shape) for n, a in named}]
+    torch.save({
+        "module": module,
+        "buffer_names": [],
+        "param_shapes": param_shapes,
+        "shared_params": {},
+        "frozen_param_shapes": None,
+        "ds_version": "0.12.7",
+        "global_steps": global_steps,
+        "global_samples": global_steps * 8,
+    }, os.path.join(d, "mp_rank_00_model_states.pt"))
+    if zero_stage == 0:
+        return d
+
+    flat = np.concatenate([a.astype(np.float32).ravel() for _, a in named])
+    mom = moments or {}
+    m_flat = {k: np.concatenate([mom[k][n].astype(np.float32).ravel()
+                                 for n, _ in named])
+              for k in mom}
+    if zero_stage <= 2:
+        # contiguous partitions, 2*world-aligned padding (zero_to_fp32:305)
+        align = 2 * dp
+        padded = int(-(-len(flat) // align) * align)
+        per = padded // dp
+
+        def rank_slice(vec, r):
+            v = np.zeros(padded, np.float32)
+            v[:len(vec)] = vec
+            return torch.from_numpy(v[r * per:(r + 1) * per].copy())
+    else:
+        # interleaved per-param partitions (zero_to_fp32:390)
+        def rank_slice(vec, r):
+            chunks = []
+            off = 0
+            for _, a in named:
+                n = a.size
+                per_p = -(-n // dp)
+                seg = np.zeros(per_p, np.float32)
+                lo = min(r * per_p, n)
+                hi = min((r + 1) * per_p, n)
+                seg[:hi - lo] = vec[off + lo:off + hi]
+                chunks.append(seg)
+                off += n
+            return torch.from_numpy(np.concatenate(chunks))
+
+    for r in range(dp):
+        fp32_key = ("single_partition_of_fp32_groups" if zero_stage <= 2
+                    else "fp32_flat_groups")
+        state_entry = {k: rank_slice(m_flat[k], r) for k in m_flat}
+        osd = {
+            "zero_stage": zero_stage,
+            "partition_count": dp,
+            "loss_scaler": None,
+            fp32_key: [rank_slice(flat, r)],
+            "base_optimizer_state": {"state": {0: state_entry},
+                                     "param_groups": [{}]},
+        }
+        torch.save({"optimizer_state_dict": osd},
+                   os.path.join(d, f"bf16_zero_pp_rank_{r}_mp_rank_00"
+                                   f"_optim_states.pt"))
+    return d
+
+
+def _engine(**over):
+    model = SimpleModel(hidden_dim=16)
+    cfg = simple_config(train_batch_size=8, train_micro_batch_size_per_gpu=1,
+                        **over)
+    engine, _, _, _ = dstpu.initialize(model=model, config=cfg)
+    return engine
+
+
+class TestInspector:
+    def test_latest_tag_and_props(self, tmp_path):
+        named = _flat_names_and_shapes(
+            {"layer_0": {"w": np.ones((4, 4)), "b": np.zeros(4)}})
+        write_reference_checkpoint(str(tmp_path), "global_step7", named,
+                                   zero_stage=2, dp=2)
+        ck = DeepSpeedCheckpoint(str(tmp_path))
+        assert ck.tag == "global_step7"
+        assert ck.zero_stage == 2 and ck.dp_degree == 2
+        assert ck.tp_degree == 1 and ck.ds_version == "0.12.7"
+        assert ck.global_steps == 7
+        sd = ck.fp32_state_dict()
+        np.testing.assert_array_equal(sd["layer_0.w"], np.ones((4, 4)))
+        np.testing.assert_array_equal(sd["layer_0.b"], np.zeros(4))
+
+    def test_missing_latest_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="latest"):
+            DeepSpeedCheckpoint(str(tmp_path))
+
+    @pytest.mark.parametrize("stage", [2, 3])
+    def test_merge_matches_source_values(self, tmp_path, stage):
+        rng = np.random.RandomState(0)
+        named = [("a.weight", rng.randn(5, 3).astype(np.float32)),
+                 ("a.bias", rng.randn(5).astype(np.float32)),
+                 ("head.weight", rng.randn(7, 5).astype(np.float32))]
+        mom = {"exp_avg": {n: rng.randn(*a.shape).astype(np.float32)
+                           for n, a in named},
+               "exp_avg_sq": {n: rng.rand(*a.shape).astype(np.float32)
+                              for n, a in named}}
+        write_reference_checkpoint(str(tmp_path), "t", named,
+                                   zero_stage=stage, dp=4, moments=mom)
+        ck = DeepSpeedCheckpoint(str(tmp_path))
+        sd = ck.fp32_state_dict()
+        for n, a in named:
+            np.testing.assert_allclose(sd[n], a, rtol=0, atol=0)
+        got = ck.optimizer_moments()
+        for key in ("exp_avg", "exp_avg_sq"):
+            for n, a in named:
+                np.testing.assert_allclose(got[key][n], mom[key][n])
+
+
+class TestEngineImport:
+    def _roundtrip(self, tmp_path, stage, dp):
+        """Engine A trains → its state written in reference layout →
+        imported into fresh engine B → same loss trajectory."""
+        import jax
+
+        eng_a = _engine(zero_optimization={"stage": min(stage, 3)})
+        data = random_dataset(8, hidden_dim=16, n_batches=3, seed=5)
+        for b in data[:2]:
+            eng_a.train_batch(b)
+
+        from deepspeedsyclsupport_tpu.utils import param_paths
+
+        paths = param_paths(eng_a.params)
+        named = [(p.replace("/", "."), safe_get_full_fp32_param(eng_a, p))
+                 for p in paths]
+        mom = {k: {p.replace("/", "."):
+                   safe_get_full_optimizer_state(eng_a, p, k)
+                   for p in paths}
+               for k in ("exp_avg", "exp_avg_sq")}
+        write_reference_checkpoint(str(tmp_path), "global_step2", named,
+                                   zero_stage=stage, dp=dp, moments=mom,
+                                   global_steps=eng_a.global_steps)
+
+        eng_b = _engine(zero_optimization={"stage": min(stage, 3)})
+        tag = load_deepspeed_checkpoint(eng_b, str(tmp_path))
+        assert tag == "global_step2"
+        assert eng_b.global_steps == eng_a.global_steps
+        for p in paths:
+            np.testing.assert_allclose(
+                safe_get_full_fp32_param(eng_b, p),
+                safe_get_full_fp32_param(eng_a, p), rtol=1e-6)
+        # loss parity on the NEXT step (moments imported too)
+        ma = eng_a.train_batch(data[2])
+        mb = eng_b.train_batch(data[2])
+        la = float(np.asarray(jax.device_get(ma["loss"])))
+        lb = float(np.asarray(jax.device_get(mb["loss"])))
+        assert abs(la - lb) < 1e-5, (la, lb)
+        for p in paths:
+            np.testing.assert_allclose(
+                safe_get_full_fp32_param(eng_b, p),
+                safe_get_full_fp32_param(eng_a, p), rtol=1e-4, atol=1e-6)
+
+    def test_stage2_dp2_roundtrip(self, tmp_path):
+        self._roundtrip(tmp_path, stage=2, dp=2)
+
+    def test_stage3_dp4_roundtrip(self, tmp_path):
+        self._roundtrip(tmp_path, stage=3, dp=4)
+
+    def test_engine_load_checkpoint_autodetects_reference_format(
+            self, tmp_path):
+        """engine.load_checkpoint on a dir holding mp_rank_* .pt files
+        routes to the importer transparently (the migration UX)."""
+        eng = _engine()
+        from deepspeedsyclsupport_tpu.utils import param_paths
+
+        paths = param_paths(eng.params)
+        named = [(p.replace("/", "."),
+                  safe_get_full_fp32_param(eng, p) * 0 + 1.5) for p in paths]
+        write_reference_checkpoint(str(tmp_path), "global_step9", named,
+                                   zero_stage=2, dp=2, global_steps=9)
+        path, extra = eng.load_checkpoint(str(tmp_path))
+        assert path is not None and path.endswith("global_step9")
+        assert eng.global_steps == 9
+        np.testing.assert_allclose(
+            safe_get_full_fp32_param(eng, paths[0]), 1.5)
+
+    def test_strict_mismatch_raises(self, tmp_path):
+        named = [("not.our.param", np.zeros(3, np.float32))]
+        write_reference_checkpoint(str(tmp_path), "t", named,
+                                   zero_stage=2, dp=1)
+        eng = _engine()
+        with pytest.raises(KeyError, match="no engine param"):
+            load_deepspeed_checkpoint(eng, str(tmp_path))
+
+    def test_name_map_and_non_strict(self, tmp_path):
+        eng = _engine()
+        from deepspeedsyclsupport_tpu.utils import param_paths
+
+        paths = param_paths(eng.params)
+        # torch-flavored names: layer_0.w -> 0.linear.weight-ish renames
+        named = [(p.replace("/", ".").replace("layer_", "seq."),
+                  safe_get_full_fp32_param(eng, p) * 0 + 3.0) for p in paths]
+        write_reference_checkpoint(str(tmp_path), "t", named, zero_stage=2,
+                                   dp=2)
+
+        def nm(torch_name):
+            return torch_name.replace("seq.", "layer_").replace(".", "/")
+
+        load_deepspeed_checkpoint(eng, str(tmp_path), name_map=nm,
+                                  load_optimizer_states=False)
+        np.testing.assert_allclose(
+            safe_get_full_fp32_param(eng, paths[0]), 3.0)
