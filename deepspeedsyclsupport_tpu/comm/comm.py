@@ -46,6 +46,7 @@ __all__ = [
 ]
 
 _INITIALIZED = False
+_DEFAULT_SLURM_PORT = 29500  # coordinator port when srun env names no port
 
 
 # ---------------------------------------------------------------------------
@@ -263,6 +264,33 @@ def init_distributed(coordinator_address: Optional[str] = None,
             raise RuntimeError(
                 "MPI launch detected but no coordinator address; set MASTER_ADDR/"
                 "MASTER_PORT (or COORDINATOR_ADDRESS) to a host:port on rank 0")
+    # PMI convention (MPICH / Intel MPI / MVAPICH launchers export PMI_RANK)
+    if auto_mpi_discovery and nprocs is None and "PMI_SIZE" in env:
+        nprocs = int(env["PMI_SIZE"])
+        pid = pid if pid is not None else int(env.get("PMI_RANK", 0))
+        if coord is None and nprocs > 1:
+            raise RuntimeError(
+                "PMI launch detected but no coordinator address; set "
+                "MASTER_ADDR/MASTER_PORT to a host:port on rank 0")
+    # SLURM srun convention (reference: SlurmRunner relies on srun's env).
+    # Gated on SLURM_STEP_ID — set only for srun-launched steps — so a bare
+    # `python train.py` inside an sbatch allocation (which still exports
+    # SLURM_NTASKS) is NOT mistaken for a distributed launch and left to
+    # initialize single-process.
+    if auto_mpi_discovery and nprocs is None and "SLURM_NTASKS" in env \
+            and "SLURM_STEP_ID" in env:
+        nprocs = int(env["SLURM_NTASKS"])
+        pid = pid if pid is not None else int(env.get("SLURM_PROCID", 0))
+        if coord is None and nprocs > 1:
+            # first host of the allocation is the conventional coordinator
+            nodelist = env.get("SLURM_JOB_NODELIST") or env.get("SLURM_NODELIST")
+            if nodelist and "[" not in nodelist:
+                coord = f"{nodelist.split(',')[0]}:{_DEFAULT_SLURM_PORT}"
+            else:
+                raise RuntimeError(
+                    "SLURM launch detected but no coordinator address and "
+                    "the nodelist is compressed; set MASTER_ADDR/MASTER_PORT "
+                    "(or COORDINATOR_ADDRESS)")
 
     if coord is None or not nprocs or nprocs <= 1:
         _INITIALIZED = True

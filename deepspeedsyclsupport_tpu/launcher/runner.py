@@ -147,6 +147,12 @@ def main(argv=None) -> int:
     p.add_argument("--master_addr", default=None)
     p.add_argument("--master_port", type=int, default=DEFAULT_MASTER_PORT)
     p.add_argument("--module", "-m", action="store_true")
+    p.add_argument("--launcher", default="ssh",
+                   help="multinode backend: ssh (built-in fan-out, default) "
+                        "or pdsh/openmpi/mpich/impi/slurm/mvapich "
+                        "(reference launcher/multinode_runner.py)")
+    p.add_argument("--launcher_args", default="",
+                   help="extra flags passed through to the backend verbatim")
     p.add_argument("--bind_cores_to_rank", action="store_true",
                    help="numactl-bind each local rank to its core slice "
                         "(reference --bind_cores_to_rank)")
@@ -157,6 +163,25 @@ def main(argv=None) -> int:
     p.add_argument("user_script")
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
+
+    if args.launcher != "ssh":
+        from .multinode_runner import build_runner
+
+        hosts = (parse_hostfile(args.hostfile) if args.hostfile
+                 else [("localhost", 1)] * args.num_nodes)
+        runner = build_runner(args.launcher, args, hosts)
+        cmd = runner.get_cmd()
+        if args.dry_run:
+            print(" ".join(shlex.quote(c) for c in cmd))
+            return 0
+        if not runner.backend_exists():
+            raise RuntimeError(
+                f"--launcher {args.launcher}: backend binary not found on "
+                f"PATH (try --dry_run to inspect the command)")
+        proc = subprocess.Popen(cmd, env={**os.environ},
+                                start_new_session=True,
+                                preexec_fn=_child_preexec)
+        return supervise([proc])
 
     world = build_world(args)
     if args.dry_run:
