@@ -27,7 +27,7 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
-from ..utils.logging import logger
+from ...utils.logging import logger
 
 # config keys — reference data_pipeline/constants.py
 FIXED_LINEAR = "fixed_linear"

@@ -1,0 +1,10 @@
+from .data_analyzer import DataAnalyzer, DifficultyIndex
+from .data_sampler import DSTpuDataSampler
+from .indexed_dataset import (MMapIndexedDataset, MMapIndexedDatasetBuilder,
+                              data_file_path, index_file_path, make_dataset)
+
+__all__ = [
+    "MMapIndexedDataset", "MMapIndexedDatasetBuilder", "make_dataset",
+    "data_file_path", "index_file_path", "DSTpuDataSampler", "DataAnalyzer",
+    "DifficultyIndex",
+]

@@ -1,0 +1,71 @@
+"""Offline data analysis → per-sample difficulty index.
+
+Analog of the reference's ``data_sampling/data_analyzer.py`` (DataAnalyzer:
+map a metric function over the corpus, write metric↔sample index files the
+curriculum sampler reads). Here the product is a :class:`DifficultyIndex` —
+per-sample metric values plus the ascending-difficulty permutation — saved
+as plain ``.npy`` files instead of nested indexed datasets: the sampler
+needs exactly (value per sample, sort order), and numpy files keep the
+artifact inspectable.
+"""
+import os
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import numpy as np
+
+
+@dataclass
+class DifficultyIndex:
+    """values[i] = metric of sample i; order = sample ids sorted ascending
+    by (metric, id) — id tiebreak keeps the permutation deterministic."""
+    values: np.ndarray
+    order: np.ndarray
+
+    def save(self, prefix: str) -> None:
+        os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
+        np.save(prefix + "_metric_values.npy", self.values)
+        np.save(prefix + "_metric_order.npy", self.order)
+
+    @classmethod
+    def load(cls, prefix: str) -> "DifficultyIndex":
+        return cls(values=np.load(prefix + "_metric_values.npy"),
+                   order=np.load(prefix + "_metric_order.npy"))
+
+    def pool_leq_value(self, difficulty) -> np.ndarray:
+        """Sample ids whose metric <= difficulty (value-based curriculum)."""
+        # order is metric-ascending: binary-search the cut
+        cut = np.searchsorted(self.values[self.order], difficulty,
+                              side="right")
+        return self.order[:cut]
+
+    def pool_percentile(self, pct: float) -> np.ndarray:
+        """The easiest ``pct`` percent of samples (percentile-based)."""
+        cut = max(1, int(len(self.order) * min(max(pct, 0.0), 100.0) / 100))
+        return self.order[:cut]
+
+
+class DataAnalyzer:
+    """Map ``metric_fn(sample) -> number`` over an indexed dataset
+    (reference ``DataAnalyzer.run_map``). Default metric is sequence length
+    — the curriculum the reference's seqlen_* metrics implement — read
+    straight from the index's ``sizes`` without touching the ``.bin``."""
+
+    def __init__(self, metric_fn: Optional[Callable] = None,
+                 metric_name: str = "seqlen"):
+        self.metric_fn = metric_fn
+        self.metric_name = metric_name
+
+    def run(self, dataset, save_prefix: Optional[str] = None
+            ) -> DifficultyIndex:
+        if self.metric_fn is None and hasattr(dataset, "sizes"):
+            values = np.asarray(dataset.sizes)
+        else:
+            fn = self.metric_fn or len
+            values = np.asarray([fn(dataset[i])
+                                 for i in range(len(dataset))])
+        order = np.lexsort((np.arange(len(values)), values))
+        idx = DifficultyIndex(values=values, order=order.astype(np.int64))
+        if save_prefix is not None:
+            idx.save(save_prefix)
+        return idx

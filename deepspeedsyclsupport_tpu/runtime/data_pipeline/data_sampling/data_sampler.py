@@ -1,0 +1,136 @@
+"""Curriculum data sampler over indexed datasets.
+
+Analog of ``DeepSpeedDataSampler``
+(``data_sampling/data_sampler.py:36``): every global step, draw the global
+batch's sample ids from the pool the curriculum currently allows (metric
+value or percentile threshold from a :class:`CurriculumScheduler`), shuffle
+deterministically, and hand THIS data-parallel rank its slice. Differences
+from the reference are deliberate: pools come from a
+:class:`~.data_analyzer.DifficultyIndex` (binary-searched, no cluster
+files), and the draw is a pure function of (seed, step) so resume needs no
+replay — ``state_dict`` is just the step/consumed counters.
+"""
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from .. import CurriculumScheduler
+from .data_analyzer import DifficultyIndex
+
+
+class DSTpuDataSampler:
+    def __init__(self, index: DifficultyIndex,
+                 curriculum: Optional[Dict] = None, *,
+                 micro_batch_size: int,
+                 data_parallel_rank: int, data_parallel_size: int,
+                 gradient_accumulation_steps: int = 1,
+                 difficulty_type: str = "value",
+                 total_steps: Optional[int] = None,
+                 seed: int = 1234, drop_last: bool = True):
+        """``curriculum``: a reference-style schedule config (the
+        ``CurriculumScheduler`` dict: schedule_type/min/max/...); None
+        disables gating (the full corpus from step 0).
+        ``difficulty_type``: 'value' (metric <= difficulty) or 'percentile'
+        (easiest d% of the corpus) — reference
+        CURRICULUM_LEARNING_DIFFICULTY_TYPE."""
+        if difficulty_type not in ("value", "percentile"):
+            raise ValueError(f"unknown difficulty_type {difficulty_type!r}")
+        self.index = index
+        self.scheduler = (CurriculumScheduler(curriculum)
+                          if curriculum is not None else None)
+        self.micro_batch_size = micro_batch_size
+        self.dp_rank = data_parallel_rank
+        self.dp_size = data_parallel_size
+        self.gas = gradient_accumulation_steps
+        self.global_batch_size = (micro_batch_size * data_parallel_size
+                                  * gradient_accumulation_steps)
+        self.difficulty_type = difficulty_type
+        self.total_steps = total_steps
+        self.seed = seed
+        self.drop_last = drop_last
+        self.step = 0
+        self.consumed_samples = 0
+        if data_parallel_rank >= data_parallel_size:
+            raise ValueError(f"dp rank {data_parallel_rank} outside world "
+                             f"{data_parallel_size}")
+
+    # ------------------------------------------------------------------ pool
+    def _pool(self, step: int) -> np.ndarray:
+        if self.scheduler is None:
+            return self.index.order
+        d = self.scheduler.update_difficulty(step)
+        self.current_difficulty = d
+        pool = (self.index.pool_leq_value(d)
+                if self.difficulty_type == "value"
+                else self.index.pool_percentile(float(d)))
+        if len(pool) == 0:
+            # an over-strict early threshold must not wedge training: fall
+            # back to the easiest micro-batch worth of samples
+            pool = self.index.order[:self.global_batch_size]
+        return pool
+
+    def batch_for_step(self, step: int) -> np.ndarray:
+        """This rank's sample ids for global step ``step``, shaped
+        [gas, micro_batch_size]. Pure in (seed, step): every rank computes
+        the same global permutation and slices its own rows (the
+        reference's get_start_end_idx contract)."""
+        pool = self._pool(step)
+        rng = np.random.default_rng((self.seed, step))
+        need = self.global_batch_size
+        if len(pool) >= need:
+            # epoch-position draw WITHIN the pool: step-scoped shuffle
+            picks = rng.choice(len(pool), size=need, replace=False)
+        else:
+            picks = rng.integers(0, len(pool), size=need)
+        ids = pool[np.sort(picks)]
+        ids = rng.permutation(ids)
+        mine = ids.reshape(self.gas, self.dp_size, self.micro_batch_size)
+        return mine[:, self.dp_rank, :]
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        while self.total_steps is None or self.step < self.total_steps:
+            out = self.batch_for_step(self.step)
+            self.step += 1
+            self.consumed_samples += self.global_batch_size
+            yield out
+
+    def __len__(self) -> int:
+        if self.total_steps is None:
+            raise TypeError("unbounded sampler (total_steps=None)")
+        return self.total_steps
+
+    # ----------------------------------------------------------------- state
+    def state_dict(self) -> Dict:
+        return {"step": self.step, "consumed_samples": self.consumed_samples,
+                "seed": self.seed}
+
+    def load_state_dict(self, state: Dict) -> None:
+        self.step = int(state["step"])
+        self.consumed_samples = int(state["consumed_samples"])
+        self.seed = int(state.get("seed", self.seed))
+
+
+class IndexedTokenBatches:
+    """Glue: (indexed dataset, sampler) → fixed-shape token batches for
+    ``DSTpuDataLoader`` / ``engine.train_batch``. Samples pad (with
+    ``pad_id``) or clip to ``seq_len``; each iteration yields
+    ``{"input_ids": int32 [gas*micro_batch, seq_len]}`` for this rank."""
+
+    def __init__(self, dataset, sampler: DSTpuDataSampler, seq_len: int,
+                 pad_id: int = 0):
+        self.dataset = dataset
+        self.sampler = sampler
+        self.seq_len = seq_len
+        self.pad_id = pad_id
+
+    def __len__(self) -> int:
+        return len(self.sampler)
+
+    def __iter__(self):
+        for ids in self.sampler:
+            flat = ids.reshape(-1)
+            batch = np.full((len(flat), self.seq_len), self.pad_id, np.int32)
+            for row, sid in enumerate(flat):
+                toks = np.asarray(self.dataset[int(sid)])[:self.seq_len]
+                batch[row, :len(toks)] = toks
+            yield {"input_ids": batch}

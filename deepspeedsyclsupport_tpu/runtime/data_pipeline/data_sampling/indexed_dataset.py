@@ -1,0 +1,169 @@
+"""Memory-mapped indexed datasets — the Megatron ``.bin``/``.idx`` format.
+
+Analog of the reference's
+``deepspeed/runtime/data_pipeline/data_sampling/indexed_dataset.py:369``
+(``MMapIndexedDataset`` + builder): token corpora pre-tokenized into one
+flat binary file plus an index of per-sample sizes/offsets, read back with
+zero-copy ``np.memmap``. The ON-DISK FORMAT is kept bit-compatible with
+Megatron-LM / DeepSpeed exports (same magic, codes, layout) so existing
+preprocessed corpora load unmodified; the implementation is original and
+torch-free (plain numpy — samples feed ``DSTpuDataLoader`` which owns
+device placement).
+
+Index layout (little-endian)::
+
+    9s  magic  b"MMIDIDX\\x00\\x00"
+    Q   version (1)
+    B   dtype code (see DTYPES)
+    Q   number of samples
+    Q   number of document positions
+    int32  sizes[n_samples]        tokens per sample
+    int64  pointers[n_samples]     byte offset of each sample in the .bin
+    int64  doc_idx[n_docs]         sample index of each document start
+"""
+import os
+import struct
+from typing import Optional, Sequence, Union
+
+import numpy as np
+
+_MAGIC = b"MMIDIDX\x00\x00"
+_VERSION = 1
+
+# dtype codes of the format (indexed_dataset.py:101 in the reference)
+DTYPES = {
+    1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32, 5: np.int64,
+    6: np.float64, 7: np.float64, 8: np.uint16, 9: np.uint32, 10: np.uint64,
+}
+_CODES = {np.dtype(v): k for k, v in reversed(sorted(DTYPES.items()))}
+
+
+def data_file_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+def index_file_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+class MMapIndexedDataset:
+    """Zero-copy reader. ``ds[i]`` → the i-th sample as a numpy view;
+    ``ds[a:b]`` → list of samples; ``ds.get(i, offset, length)`` → a slice
+    of one sample (the reference's partial-read API)."""
+
+    def __init__(self, path_prefix: str):
+        self._prefix = path_prefix
+        with open(index_file_path(path_prefix), "rb") as f:
+            magic = f.read(9)
+            if magic != _MAGIC:
+                raise ValueError(
+                    f"{index_file_path(path_prefix)}: not an MMIDIDX index "
+                    f"(bad magic {magic!r})")
+            (version,) = struct.unpack("<Q", f.read(8))
+            if version != _VERSION:
+                raise ValueError(f"unsupported index version {version}")
+            (code,) = struct.unpack("<B", f.read(1))
+            self.dtype = np.dtype(DTYPES[code])
+            (n, ) = struct.unpack("<Q", f.read(8))
+            (n_docs, ) = struct.unpack("<Q", f.read(8))
+            header_end = f.tell()
+        idx = np.memmap(index_file_path(path_prefix), mode="r", order="C")
+        off = header_end
+        self.sizes = np.frombuffer(idx, np.int32, count=n, offset=off)
+        off += n * 4
+        self._pointers = np.frombuffer(idx, np.int64, count=n, offset=off)
+        off += n * 8
+        self.doc_idx = np.frombuffer(idx, np.int64, count=n_docs, offset=off)
+        self._bin = np.memmap(data_file_path(path_prefix), mode="r",
+                              dtype=self.dtype, order="C")
+
+    def __len__(self) -> int:
+        return len(self.sizes)
+
+    def __getitem__(self, i: Union[int, slice]):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        if i < 0:
+            i += len(self)
+        start = self._pointers[i] // self.dtype.itemsize
+        return self._bin[start:start + self.sizes[i]]
+
+    def get(self, i: int, offset: int = 0,
+            length: Optional[int] = None) -> np.ndarray:
+        """Partial sample read (reference ``MMapIndexedDataset.get``)."""
+        size = int(self.sizes[i])
+        if length is None:
+            length = size - offset
+        if offset < 0 or offset + length > size:
+            raise IndexError(f"slice [{offset}:{offset + length}] outside "
+                             f"sample {i} of size {size}")
+        start = self._pointers[i] // self.dtype.itemsize + offset
+        return self._bin[start:start + length]
+
+    @property
+    def supports_prefetch(self) -> bool:
+        return False  # mmap pages on demand
+
+    @staticmethod
+    def exists(path_prefix: str) -> bool:
+        return (os.path.exists(index_file_path(path_prefix))
+                and os.path.exists(data_file_path(path_prefix)))
+
+
+class MMapIndexedDatasetBuilder:
+    """Streaming writer (reference ``MMapIndexedDatasetBuilder:575``):
+    ``add_item`` per sample, ``end_document`` at document boundaries,
+    ``finalize`` writes the index."""
+
+    def __init__(self, out_file: str, dtype=np.int32):
+        self._bin = open(out_file, "wb")
+        self.dtype = np.dtype(dtype)
+        self._sizes = []
+        self._doc_idx = [0]
+
+    def add_item(self, tokens: Sequence) -> None:
+        arr = np.asarray(tokens, dtype=self.dtype)
+        self._bin.write(arr.tobytes(order="C"))
+        self._sizes.append(len(arr))
+
+    def end_document(self) -> None:
+        self._doc_idx.append(len(self._sizes))
+
+    def add_dataset(self, other: "MMapIndexedDataset") -> None:
+        """Merge another indexed dataset (the reference's merge path for
+        sharded preprocessing jobs)."""
+        if other.dtype != self.dtype:
+            raise ValueError(f"dtype mismatch: {other.dtype} vs {self.dtype}")
+        base = len(self._sizes)
+        for i in range(len(other)):
+            self.add_item(other[i])
+        self._doc_idx.extend(base + d for d in other.doc_idx[1:])
+
+    def finalize(self, index_file: str) -> None:
+        self._bin.close()
+        sizes = np.asarray(self._sizes, np.int32)
+        pointers = np.zeros(len(sizes), np.int64)
+        if len(sizes) > 1:
+            np.cumsum(sizes[:-1] * self.dtype.itemsize, out=pointers[1:])
+        with open(index_file, "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<Q", _VERSION))
+            f.write(struct.pack("<B", _CODES[self.dtype]))
+            f.write(struct.pack("<Q", len(sizes)))
+            f.write(struct.pack("<Q", len(self._doc_idx)))
+            f.write(sizes.tobytes(order="C"))
+            f.write(pointers.tobytes(order="C"))
+            f.write(np.asarray(self._doc_idx, np.int64).tobytes(order="C"))
+
+
+def make_dataset(path_prefix: str, impl: str = "mmap") -> MMapIndexedDataset:
+    """Factory (reference ``make_dataset``): only the mmap impl exists here —
+    the reference's ``cached``/``lazy`` variants predate it and are
+    deprecated upstream."""
+    if impl not in ("mmap", "infer"):
+        raise ValueError(f"unsupported indexed dataset impl {impl!r} "
+                         f"(mmap only)")
+    if not MMapIndexedDataset.exists(path_prefix):
+        raise FileNotFoundError(f"no indexed dataset at {path_prefix}"
+                                f"(.bin/.idx)")
+    return MMapIndexedDataset(path_prefix)
