@@ -30,12 +30,47 @@ from ..utils.logging import log_dist, logger
 @dataclass
 class TuneResult:
     best_config: Dict[str, Any]
-    best_throughput: float  # samples/sec
+    best_throughput: float  # samples/sec (train) or tokens/sec (serve)
     trials: List[Dict[str, Any]] = field(default_factory=list)
 
     @property
     def pruned(self) -> List[Dict[str, Any]]:
         return [t for t in self.trials if t.get("pruned")]
+
+    def write_report(self, path: str) -> str:
+        """Reference-style report artifact (the summary/exps files the
+        reference autotuner leaves behind, ``autotuning/autotuner.py:1``):
+        a JSON record plus a human-readable ranking table."""
+        import json
+        import os
+
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        record = {
+            "best_throughput": self.best_throughput,
+            "best_config": self.best_config,
+            "num_trials": len(self.trials),
+            "num_pruned": len(self.pruned),
+            "trials": self.trials,
+        }
+        with open(path, "w") as f:
+            json.dump(record, f, indent=2, default=str)
+        ranked = sorted((t for t in self.trials if not t.get("pruned")),
+                        key=lambda t: -t["throughput"])
+        lines = [f"{'rank':<6}{'throughput':>14}  config",
+                 "-" * 72]
+        for i, t in enumerate(ranked):
+            label = {k: v for k, v in t.items()
+                     if k not in ("throughput", "predicted_bytes", "pruned",
+                                  "error")}
+            lines.append(f"{i:<6}{t['throughput']:>14.1f}  {label}")
+        for t in self.pruned:
+            label = {k: v for k, v in t.items()
+                     if k not in ("throughput", "predicted_bytes", "pruned")}
+            lines.append(f"{'—':<6}{'pruned':>14}  {label}")
+        txt = path.rsplit(".", 1)[0] + "_summary.txt"
+        with open(txt, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        return path
 
 
 DEFAULT_SPACE = {
@@ -43,6 +78,12 @@ DEFAULT_SPACE = {
     "zero_optimization.stage": [0, 1, 2, 3],
     "activation_checkpointing.enabled": [False, True],
     "zero_optimization.offload_optimizer.device": ["none", "cpu"],
+}
+
+# serve rung: the SplitFuse scheduler's two first-order knobs
+DEFAULT_SERVE_SPACE = {
+    "max_tokens_per_batch": [64, 128, 256, 512],
+    "block_size": [16, 32, 64],
 }
 
 
@@ -56,22 +97,54 @@ def _set_nested(cfg: Dict, dotted: str, value):
 
 class Autotuner:
     def __init__(self, model, base_config: Dict[str, Any],
-                 make_batch: Callable[[int], Any],
+                 make_batch: Optional[Callable[[int], Any]] = None,
                  space: Optional[Dict[str, Sequence]] = None,
                  steps: int = 3, warmup: int = 1,
                  hbm_bytes: Optional[float] = None,
-                 seq_len: Optional[int] = None):
+                 seq_len: Optional[int] = None,
+                 mode: str = "in_process",
+                 kind: str = "train",
+                 model_name: Optional[str] = None,
+                 model_kw: Optional[Dict[str, Any]] = None,
+                 trial_timeout: float = 600.0,
+                 trial_env: Optional[Dict[str, str]] = None):
         """``make_batch(global_batch_size) -> batch`` supplies data per
-        trial. ``hbm_bytes`` enables model-based pruning against a device
-        memory budget (None: probe the accelerator, 0/failed probe: no
-        pruning). ``seq_len`` feeds the activation-memory model (defaults
-        to the model config's ``max_seq_len`` when available)."""
+        in-process trial. ``hbm_bytes`` enables model-based pruning against
+        a device memory budget (None: probe the accelerator, 0/failed
+        probe: no pruning). ``seq_len`` feeds the activation-memory model
+        (defaults to the model config's ``max_seq_len`` when available).
+
+        ``mode='subprocess'`` runs every measured trial in its own child
+        interpreter (the reference's experiment-per-job isolation,
+        ``autotuning/scheduler.py``): an OOM or wedged compile kills the
+        child, scores -inf, and the search continues. Requires the model to
+        be nameable in the zoo (``model_name`` + ``model_kw``).
+        ``kind='serve'`` tunes the v2 serving engine (token budget / block
+        size space) by measured decode tokens/sec instead of the train
+        step."""
+        if mode not in ("in_process", "subprocess"):
+            raise ValueError(f"unknown mode {mode!r}")
+        if kind not in ("train", "serve"):
+            raise ValueError(f"unknown kind {kind!r}")
+        if mode == "subprocess" and model_name is None:
+            raise ValueError("subprocess mode needs model_name= (a models/ "
+                             "zoo name the child can rebuild)")
+        if kind == "serve" and mode != "subprocess":
+            raise ValueError("serve tuning runs trials in subprocesses "
+                             "(each trial owns the device)")
         self.model = model
         self.base_config = base_config
         self.make_batch = make_batch
-        self.space = space or DEFAULT_SPACE
+        self.space = space or (DEFAULT_SPACE if kind == "train"
+                               else DEFAULT_SERVE_SPACE)
         self.steps = steps
         self.warmup = warmup
+        self.mode = mode
+        self.kind = kind
+        self.model_name = model_name
+        self.model_kw = model_kw or {}
+        self.trial_timeout = trial_timeout
+        self.trial_env = trial_env or {}
         if hbm_bytes is None:
             hbm_bytes = self._probe_hbm()
         self.hbm_bytes = hbm_bytes or 0
@@ -112,13 +185,38 @@ class Autotuner:
             d = d[p]
         return d
 
-    def _predict_bytes(self, label: Dict[str, Any]) -> float:
+    def _fsdp_factor(self, cfg: Dict[str, Any], stage: int, label) -> int:
+        """Shard factor the trial's topology will actually use. Deriving it
+        from the trial's ParallelismConfig (not ``device_count()``) matters
+        when the base config dedicates devices to tp/pp/ep/sp: assuming the
+        whole world shards the optimizer over-divides per-device memory and
+        prunes candidates that would fit."""
+        import jax
+
+        if stage < 1:
+            return 1
+        n_dev = jax.device_count()
+        try:
+            from ..runtime.config import ParallelismConfig
+
+            mics = int(self._effective(
+                label, "zero_optimization.mics_shard_size", -1) or -1)
+            p = ParallelismConfig.from_config_dict(cfg, stage, mics)
+            fixed = max(1, p.tp * p.pp * p.ep * p.sp)
+            if p.fsdp > 0:
+                return p.fsdp
+            dp = p.dp if p.dp > 0 else 1
+            return max(1, n_dev // (fixed * dp))
+        except Exception:
+            return n_dev
+
+    def _predict_bytes(self, label: Dict[str, Any],
+                       cfg: Optional[Dict[str, Any]] = None) -> float:
         """Device-memory prediction for one candidate (0 = unknown)."""
         from ..runtime.zero import predict_memory_per_device
 
         if not self._n_params:
             return 0
-        import jax
 
         mcfg = getattr(self.model, "config", None)
         hidden = getattr(mcfg, "hidden_size", 0)
@@ -135,7 +233,8 @@ class Autotuner:
         # (qkv, scores-free flash, mlp intermediates, residuals)
         act = (mbs * self.seq_len * hidden * 4 * 16 * layers
                if hidden and self.seq_len else 0.0)
-        fsdp = jax.device_count() if stage >= 1 else 1
+        fsdp = self._fsdp_factor(cfg if cfg is not None else self.base_config,
+                                 stage, label)
         return predict_memory_per_device(
             self._n_params, fsdp, stage, offload=offload,
             activation_bytes=act, remat=remat, num_layers=layers)
@@ -153,7 +252,8 @@ class Autotuner:
                 # must CLEAR an offload section the base config carries,
                 # and writing the leaf key preserves sibling settings
                 _set_nested(cfg, k, v)
-            pred = self._predict_bytes(label)
+            pred = (self._predict_bytes(label, cfg)
+                    if self.kind == "train" else 0)
             if self.hbm_bytes and pred > self.hbm_bytes:
                 trials.append({**label, "throughput": float("-inf"),
                                "pruned": True,
@@ -162,7 +262,8 @@ class Autotuner:
                             "budget %.2f GB)", label, pred / 1e9,
                             self.hbm_bytes / 1e9)
                 continue
-            tput = self._measure(cfg, label)
+            tput = (self._measure(cfg, label) if self.mode == "in_process"
+                    else self._measure_subprocess(cfg, label))
             trials.append({**label, "throughput": tput,
                            "predicted_bytes": pred})
             if tput > best[1]:
@@ -201,6 +302,56 @@ class Autotuner:
         except Exception as e:  # OOM / invalid combo → skip, keep searching
             logger.warning("autotune trial %s failed: %s", label, e)
             return float("-inf")
+
+    def _measure_subprocess(self, cfg: Dict[str, Any], label) -> float:
+        """One measured trial in its own interpreter (reference: each
+        experiment is its own job). Child crash/timeout/OOM → -inf."""
+        import json
+        import os
+        import subprocess
+        import sys
+        import tempfile
+
+        payload = {
+            "kind": self.kind,
+            "model": self.model_name,
+            "model_kw": self.model_kw,
+            "config": cfg,
+            "steps": self.steps,
+            "warmup": self.warmup,
+            "seq_len": self.seq_len or None,
+        }
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            json.dump(payload, f)
+            path = f.name
+        env = {**os.environ, **self.trial_env}
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m",
+                 "deepspeedsyclsupport_tpu.autotuning.trial_runner", path],
+                capture_output=True, text=True, timeout=self.trial_timeout,
+                env=env)
+        except subprocess.TimeoutExpired:
+            logger.warning("autotune trial %s timed out after %.0fs", label,
+                           self.trial_timeout)
+            return float("-inf")
+        finally:
+            os.unlink(path)
+        for line in reversed((proc.stdout or "").splitlines()):
+            if line.startswith("DSTPU_TRIAL "):
+                result = json.loads(line[len("DSTPU_TRIAL "):])
+                if result.get("ok"):
+                    log_dist(f"autotune trial {label}: "
+                             f"{result['throughput']:.1f} {result['unit']}")
+                    return float(result["throughput"])
+                logger.warning("autotune trial %s failed in child: %s",
+                               label, result.get("error"))
+                return float("-inf")
+        logger.warning("autotune trial %s: child emitted no result "
+                       "(rc=%d): %s", label, proc.returncode,
+                       (proc.stderr or "")[-500:])
+        return float("-inf")
 
 
 def _deepcopy_config(cfg):

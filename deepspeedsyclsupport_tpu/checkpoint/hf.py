@@ -1101,10 +1101,17 @@ def load_hf_encoder_checkpoint(path: str, dtype: Any = None,
                 leaf[segs[-1]] = np.empty((cfg.num_layers,) + arr.shape,
                                           arr.dtype)
             leaf[segs[-1]][i] = arr
-    if missing:
+    # heads the model owns but the family's map never references at all
+    # (e.g. BertModel's pooler on a DistilBERT export, which has no pooler):
+    # they would otherwise keep random init with no warning and pooled()
+    # would silently return garbage
+    mapped_roots = {segs[0] for segs in top} | {"layers"}
+    unmapped = [k for k in params if k not in mapped_roots]
+    if missing or unmapped:
         logger.warning("encoder checkpoint %s: %d heads kept at random "
-                       "init (absent from export): %s", path, len(missing),
-                       missing[:4])
+                       "init (absent from export): %s%s", path,
+                       len(missing) + len(unmapped), missing[:4],
+                       f"; unmapped for {mt}: {unmapped}" if unmapped else "")
     if dtype is not None:
         params = jax.tree_util.tree_map(
             lambda x: x.astype(dtype)

@@ -33,6 +33,7 @@ class CommsLogger:
         self.debug = debug
         self._lock = threading.Lock()
         self._records: Dict[str, _OpRecord] = defaultdict(_OpRecord)
+        self._wall: Dict[str, float] = {}
 
     def configure(self, enabled: Optional[bool] = None, verbose: Optional[bool] = None):
         if enabled is not None:
@@ -55,22 +56,88 @@ class CommsLogger:
 
             logger.info("comm op: %s | bytes: %d | shape: %s", key, nbytes, shape)
 
-    def log_summary(self) -> str:
-        """Render a summary table (reference: ``log_summary`` via ``comm/comm.py:422``)."""
-        lines = [f"{'op':<40}{'count':>10}{'total MB':>14}"]
+    def record_hlo(self, summary: Dict[str, Dict], tag: str) -> None:
+        """Merge a post-compile collective summary (``hlo_comms``) under
+        ``xla::`` keys. Idempotent per (op, tag): re-recording the same
+        compiled program replaces rather than double-counts."""
+        with self._lock:
+            for op, s in summary.items():
+                rec = self._records[f"xla::{op}[{tag}]"]
+                rec.count = s["count"]
+                rec.total_bytes = s["total_bytes"]
+
+    def record_wall(self, name: str, seconds: float) -> None:
+        """Accumulate wall-clock against a name (engine step timing) — the
+        basis of the straggler columns."""
+        with self._lock:
+            self._wall[name] = self._wall.get(name, 0.0) + seconds
+
+    def log_summary(self, show_straggler: bool = False) -> str:
+        """Render a summary table (reference: ``log_summary`` via
+        ``comm/comm.py:422``; ``show_straggler`` analog of
+        ``utils/comms_logging.py:108``). Straggler semantics under SPMD:
+        per-op latency is invisible (collectives fuse into one program), so
+        the columns compare each HOST's accumulated step wall-clock —
+        min/max across the controllers; a host far above min is the
+        straggler."""
+        lines = [f"{'op':<44}{'count':>10}{'total MB':>14}"]
         with self._lock:
             for key in sorted(self._records):
                 rec = self._records[key]
-                lines.append(f"{key:<40}{rec.count:>10}{rec.total_bytes / 2**20:>14.2f}")
+                lines.append(f"{key:<44}{rec.count:>10}"
+                             f"{rec.total_bytes / 2**20:>14.2f}")
+            wall = dict(self._wall)
+        if show_straggler:
+            lines.append("")
+            lines.append(f"{'wall-clock (per host)':<44}{'self s':>10}"
+                         f"{'min s':>10}{'max s':>10}")
+            for name, mine, lo, hi in self._straggler_rows(wall):
+                lines.append(f"{name:<44}{mine:>10.3f}{lo:>10.3f}"
+                             f"{hi:>10.3f}")
         table = "\n".join(lines)
         from ..utils.logging import logger
 
         logger.info("\n%s", table)
         return table
 
+    @staticmethod
+    def _straggler_rows(wall: Dict[str, float]):
+        """[(name, self, min, max)] across controllers, via ONE collective.
+
+        COLLECTIVE CONTRACT: under multiple controllers every host must call
+        ``log_summary(show_straggler=True)`` together (like any collective)
+        with the SAME set of timed names — a rank-0-only call would hang at
+        the gather. Name-set agreement is verified by gathering a digest in
+        the same call; disagreement raises instead of silently misaligning
+        columns."""
+        import jax
+        import numpy as np
+
+        names = sorted(wall)
+        vals = np.asarray([wall[n] for n in names], np.float64)
+        if jax.process_count() == 1:
+            return [(n, wall[n], wall[n], wall[n]) for n in names]
+        import hashlib
+
+        from jax.experimental import multihost_utils
+
+        digest = np.frombuffer(hashlib.sha256(
+            "|".join(names).encode()).digest()[:8], np.uint64)[0]
+        gathered = multihost_utils.process_allgather(
+            {"digest": digest, "vals": vals})
+        if not (np.asarray(gathered["digest"]) ==
+                gathered["digest"][0]).all():
+            raise RuntimeError(
+                "show_straggler: hosts timed different op names — every "
+                "controller must record the same wall-clock keys")
+        allv = np.asarray(gathered["vals"])  # [hosts, names]
+        return [(n, wall[n], float(allv[:, i].min()),
+                 float(allv[:, i].max())) for i, n in enumerate(names)]
+
     def reset(self):
         with self._lock:
             self._records.clear()
+            self._wall.clear()
 
     def snapshot(self) -> Dict[str, Dict[str, int]]:
         with self._lock:

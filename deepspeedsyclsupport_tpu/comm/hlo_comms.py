@@ -1,0 +1,113 @@
+"""Post-compile accounting of XLA-inserted collectives.
+
+The façade logger (``comms_logging.py``) sees only EXPLICIT collective
+calls; under SPMD most traffic — every stage-2/3 all-gather and
+reduce-scatter the partitioner inserts — never passes through it. This
+module closes that gap (reference: per-op logging in ``comm/comm.py:101``
+has the same blind spot for its fused paths, which is why its
+``log_summary`` is authoritative there and ours must read the compiled
+program): walk the optimized HLO of a compiled step and tally every
+collective op's payload bytes.
+
+The parse works on the compiled module text (``Compiled.as_text()``) —
+stable, version-robust fields: result shape, opcode, replica_groups.
+"""
+import re
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+# opcodes that move data between devices (start/done pairs counted once)
+COLLECTIVE_OPS = (
+    "all-gather", "all-reduce", "reduce-scatter", "all-to-all",
+    "collective-permute", "collective-broadcast", "ragged-all-to-all",
+)
+
+_DTYPE_BYTES = {
+    "pred": 1, "s2": 1, "s4": 1, "s8": 1, "u2": 1, "u4": 1, "u8": 1,
+    "s16": 2, "u16": 2, "s32": 4, "u32": 4, "s64": 8, "u64": 8,
+    "f8e4m3fn": 1, "f8e5m2": 1, "bf16": 2, "f16": 2, "f32": 4, "f64": 8,
+    "c64": 8, "c128": 16,
+}
+
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_INSTR_RE = re.compile(
+    r"=\s*((?:\([^)]*\)|[a-z0-9]+\[[0-9,]*\](?:\{[^}]*\})?))\s+"
+    r"(" + "|".join(COLLECTIVE_OPS) + r")(-start|-done)?\(")
+_GROUPS_RE = re.compile(
+    r"replica_groups=(\{\{[^}]*\}(?:,\{[^}]*\})*\}|\[[0-9,]+\]<=\[[0-9,]+\])")
+
+
+def _shape_bytes(shape_text: str) -> int:
+    """Total bytes of a shape expression — 'f32[8,128]{1,0}' or a tuple
+    '(bf16[4,2], u32[4])'."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_text):
+        if dtype not in _DTYPE_BYTES:
+            continue  # token like an opcode; shapes only
+        n = 1
+        if dims:
+            n = int(np.prod([int(d) for d in dims.split(",")]))
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def _group_size(line: str) -> Optional[int]:
+    m = _GROUPS_RE.search(line)
+    if not m:
+        return None
+    text = m.group(1)
+    if text.startswith("{{"):
+        first = text[2:].split("}", 1)[0]
+        return len([t for t in first.split(",") if t.strip()])
+    # iota form [N,M]<=[...]: groups of size M
+    dims = text[1:].split("]", 1)[0].split(",")
+    return int(dims[-1])
+
+
+def parse_collectives(hlo_text: str) -> List[Dict[str, Any]]:
+    """Every data-moving collective in a compiled HLO module."""
+    out = []
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.search(line)
+        if not m:
+            continue
+        shape_text, opcode, phase = m.group(1), m.group(2), m.group(3)
+        if phase == "-done":
+            continue  # the -start carries the payload; count pairs once
+        if phase == "-start" and shape_text.startswith("("):
+            # async start results are (aliased operand(s), output): only the
+            # LAST tuple element is the payload actually moved — counting
+            # the whole tuple would ~double every async collective
+            shape_text = shape_text.rstrip(")").rsplit(",", 1)[-1].strip()
+        out.append({
+            "op": opcode,
+            "bytes": _shape_bytes(shape_text),
+            "shape": shape_text.split("{")[0],
+            "group_size": _group_size(line),
+        })
+    return out
+
+
+def summarize_collectives(hlo_text: str) -> Dict[str, Dict[str, Any]]:
+    """{opcode: {count, total_bytes, example_shape, group_size}}."""
+    summary: Dict[str, Dict[str, Any]] = defaultdict(
+        lambda: {"count": 0, "total_bytes": 0, "example_shape": None,
+                 "group_size": None})
+    for rec in parse_collectives(hlo_text):
+        s = summary[rec["op"]]
+        s["count"] += 1
+        s["total_bytes"] += rec["bytes"]
+        if s["example_shape"] is None or rec["bytes"] > _shape_bytes(
+                s["example_shape"] or ""):
+            s["example_shape"] = rec["shape"]
+        if rec["group_size"]:
+            s["group_size"] = rec["group_size"]
+    return dict(summary)
+
+
+def summarize_compiled(compiled) -> Dict[str, Dict[str, Any]]:
+    """Summary from a ``jax.stages.Compiled`` (or anything with
+    ``as_text()``)."""
+    return summarize_collectives(compiled.as_text())
