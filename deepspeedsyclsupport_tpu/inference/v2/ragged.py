@@ -59,6 +59,8 @@ class SequenceDescriptor:
     n_cached: int = 0                                 # tokens with KV in cache
     blocks: List[int] = field(default_factory=list)   # owned KV block ids
     last_logits: Optional[np.ndarray] = None          # set when pending drains
+    last_scheduled: int = -1   # engine forward-tick of the last chunk (LRU
+    #                            eviction + prefill round-robin fairness)
 
     @property
     def needs_tokens(self) -> int:

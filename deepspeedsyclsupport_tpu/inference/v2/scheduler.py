@@ -19,7 +19,8 @@ from .ragged import BlockedAllocator, SequenceDescriptor
 def schedule_chunks(seqs: Sequence[SequenceDescriptor],
                     allocator: BlockedAllocator,
                     *, max_tokens: int, max_sequences: int, block_size: int,
-                    max_context: int
+                    max_context: int,
+                    max_prefill_fraction: float = 1.0
                     ) -> List[Tuple[SequenceDescriptor, int]]:
     """Pick ``(sequence, n_tokens)`` chunks for one forward.
 
@@ -27,12 +28,23 @@ def schedule_chunks(seqs: Sequence[SequenceDescriptor],
     first; prompt-phase sequences then split/fuse into the remaining budget.
     Block allocation happens here so a chunk is only admitted if its KV fits
     (the ``can_schedule`` KV-pressure check, ``engine_v2.py:179``).
+
+    ``max_prefill_fraction`` bounds the share of the TOKEN BUDGET prompt
+    chunks may take in a forward that also carries decode tokens — the
+    inter-token-latency lever for the reference's SLA-bound serving
+    (``blogs/deepspeed-fastgen/README.md:163``: decode ITL must not spike
+    when a long prompt arrives). Pure-prefill forwards (no decodes live)
+    ignore it. Prompt order is least-recently-scheduled first, so a prompt
+    that kept losing admission races cannot starve behind later arrivals.
     """
     chunks: List[Tuple[SequenceDescriptor, int]] = []
     budget = max_tokens
 
     decode = [d for d in seqs if d.needs_tokens == 1 and d.n_cached > 0]
     prefill = [d for d in seqs if d.needs_tokens > 0 and d not in decode]
+    # fairness: starved prompts (older last_scheduled) first; ties keep
+    # arrival (dict) order via the stable sort
+    prefill.sort(key=lambda d: d.last_scheduled)
 
     for d in decode:
         if budget < 1 or len(chunks) >= max_sequences:
@@ -42,6 +54,8 @@ def schedule_chunks(seqs: Sequence[SequenceDescriptor],
         chunks.append((d, 1))
         budget -= 1
 
+    if chunks and max_prefill_fraction < 1.0:
+        budget = min(budget, int(max_tokens * max_prefill_fraction))
     for d in prefill:
         if budget < 1 or len(chunks) >= max_sequences:
             break
