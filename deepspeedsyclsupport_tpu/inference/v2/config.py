@@ -25,10 +25,12 @@ class RaggedInferenceConfig:
     quantize_weights: bool = False   # ZeRO-Inference int8/int4 layer weights
     quant_group_size: int = 64
     quant_bits: int = 8              # 8 or 4 (packed)
-    # mixed/prefill-batch attention path: "kernel" = ragged paged-attention
-    # Pallas kernel (atoms; the blocked_flash analog), "flash" = packed flash
-    # over gathered per-sequence KV, "xla" = exact reference
-    prefill_attn: str = "auto"  # auto | kernel | kernel_interpret | flash | xla
+    # mixed/prefill-batch attention impl, resolved through the pluggable
+    # registry (module_registry.py): "auto" or any registered name —
+    # built-ins: kernel (ragged paged-attention Pallas; atoms), flash
+    # (packed flash over gathered KV), xla (exact reference),
+    # kernel_interpret (debug); user-registered names work too
+    prefill_attn: str = "auto"
     atom_q_size: Optional[int] = None  # q rows per atom (default ≤128)
     # serving policy (VERDICT r3 weak #6 — FIFO + longest-evict only):
     # bound on the token-budget share prompts may take in a forward that
@@ -39,11 +41,13 @@ class RaggedInferenceConfig:
     eviction_policy: str = "longest_context"
 
     def __post_init__(self):
-        if self.prefill_attn not in ("auto", "kernel", "kernel_interpret",
-                                     "flash", "xla"):
+        if not isinstance(self.prefill_attn, str) or not self.prefill_attn:
             raise ValueError(
-                f"prefill_attn must be auto|kernel|kernel_interpret|flash|"
-                f"xla, got {self.prefill_attn!r}")
+                f"prefill_attn must name a registered implementation or "
+                f"'auto', got {self.prefill_attn!r}")
+        # names resolve against the pluggable registry at engine build
+        # (module_registry.py) — not a closed enum, so user-registered
+        # implementations are selectable from the same config key
         if not 0.0 < self.max_prefill_fraction <= 1.0:
             raise ValueError(f"max_prefill_fraction must be in (0, 1], got "
                              f"{self.max_prefill_fraction}")

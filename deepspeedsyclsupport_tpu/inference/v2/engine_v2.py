@@ -88,12 +88,20 @@ class InferenceEngineV2:
         self._decode_forward = None  # built lazily (kernel path)
         self._rng = jax.random.PRNGKey(cfg.seed)
         self._sample_fn = jax.jit(sample_token, static_argnums=(2,))
-        # atoms feed only the ragged paged-attention kernel path — decide
-        # ONCE whether that path runs so prefill forwards skip the host atom
-        # build + five-array transfer when it cannot
-        self._use_atoms = (cfg.prefill_attn in ("kernel", "kernel_interpret")
-                           or (cfg.prefill_attn == "auto"
-                               and jax.default_backend() == "tpu"))
+        # atoms feed only impls that declare needs_atoms — decide ONCE
+        # whether that path runs so prefill forwards skip the host atom
+        # build + five-array transfer when it cannot (registry metadata;
+        # "auto" resolves against an atoms-present context)
+        from .module_registry import select_impl as _sel
+
+        try:
+            spec = _sel("prefill_attn", cfg.prefill_attn,
+                        {"backend": jax.default_backend(),
+                         "has_atoms": True})
+        except KeyError as e:
+            # get_impl's message already names the registered impls
+            raise ValueError(str(e)) from e
+        self._use_atoms = bool(spec.metadata.get("needs_atoms"))
         log_dist(f"ragged engine: {cfg.num_blocks} KV blocks × {cfg.block_size} "
                  f"tokens, budget {cfg.max_tokens_per_batch} tok/fwd, "
                  f"≤{cfg.max_sequences} seqs")

@@ -25,16 +25,36 @@ reference's v2 model zoo (``inference/v2/model_implementations/{llama_v2,
 mistral,mixtral,opt,falcon,phi}.py``) as config axes instead of classes.
 """
 from functools import partial
-from typing import Any, Tuple
+from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .kv_cache import BlockedKV
+from .module_registry import register_impl, select_impl
 from ...models.layers import alibi_slopes, apply_rope, mlp_block, norm
 
 NEG_INF = jnp.finfo(jnp.float32).min
+
+
+class PrefillAttnContext(NamedTuple):
+    """Everything a prefill-attention implementation may consume — the
+    uniform contract registered impls are called with (the reference's
+    ConfigBundle role, ``modules/module_registry.py``)."""
+    k_cache: Any
+    v_cache: Any
+    token_seq: Any
+    token_pos: Any
+    block_tables: Any
+    block_size: int
+    alibi: Any
+    window: Optional[int]
+    atom_qidx: Any = None
+    atom_pos0: Any = None
+    atom_qlen: Any = None
+    atom_tables: Any = None
+    atom_inv: Any = None
 
 
 def _dequant(p, dtype):
@@ -212,6 +232,57 @@ def _packed_flash_attention(q, k_cache, v_cache, token_seq, token_pos,
     return out[0]
 
 
+# ------------------------------------------ registered prefill-attn impls
+# (the reference's modules/implementations/* + heuristics, as registry
+# entries; users can register_impl their own and name it in the config)
+def _has_atoms(ctx):
+    return bool(ctx.get("has_atoms"))
+
+
+@register_impl("prefill_attn", "kernel", priority=10, available=_has_atoms,
+               auto_eligible=lambda c: _has_atoms(c)
+               and c.get("backend") == "tpu",
+               metadata={"needs_atoms": True})
+def _prefill_kernel_impl(q, ctx: PrefillAttnContext, interpret=False):
+    """Ragged paged-attention Pallas kernel (arXiv:2604.15464; reference
+    blocked_flash + atom_builder): q gathers into fixed-size
+    single-sequence atoms; KV blocks stream via block-table DMA — the
+    [S, max_ctx] HBM gather of the xla impl never happens."""
+    from ...ops.paged_attention import ragged_prefill_attention
+
+    q_at = q[ctx.atom_qidx]                          # [A, BQ, H, D]
+    out_at = ragged_prefill_attention(
+        q_at, ctx.k_cache, ctx.v_cache, ctx.atom_tables, ctx.atom_pos0,
+        ctx.atom_qlen, block_size=ctx.block_size, alibi=ctx.alibi,
+        window=ctx.window,
+        impl="pallas_interpret" if interpret else "pallas")
+    flat = out_at.reshape(-1, *out_at.shape[2:])
+    return flat[ctx.atom_inv]                        # back to packed rows
+
+
+@register_impl("prefill_attn", "kernel_interpret", priority=-10,
+               available=_has_atoms, auto_eligible=lambda c: False,
+               metadata={"needs_atoms": True})
+def _prefill_kernel_interpret_impl(q, ctx: PrefillAttnContext):
+    return _prefill_kernel_impl(q, ctx, interpret=True)
+
+
+@register_impl("prefill_attn", "flash", priority=5,
+               auto_eligible=lambda c: c.get("backend") == "tpu")
+def _prefill_flash_impl(q, ctx: PrefillAttnContext):
+    return _packed_flash_attention(q, ctx.k_cache, ctx.v_cache,
+                                   ctx.token_seq, ctx.token_pos,
+                                   ctx.block_tables, ctx.block_size,
+                                   alibi=ctx.alibi, window=ctx.window)
+
+
+@register_impl("prefill_attn", "xla", priority=0)
+def _prefill_xla_impl(q, ctx: PrefillAttnContext):
+    return _paged_attention(q, ctx.k_cache, ctx.v_cache, ctx.token_seq,
+                            ctx.token_pos, ctx.block_tables, ctx.block_size,
+                            alibi=ctx.alibi, window=ctx.window)
+
+
 def ragged_forward(model, params: Any, kv: BlockedKV, tokens, token_seq,
                    token_pos, block_tables, last_tok_idx,
                    atom_qidx=None, atom_pos0=None, atom_qlen=None,
@@ -243,6 +314,14 @@ def ragged_forward(model, params: Any, kv: BlockedKV, tokens, token_seq,
         p, k_cache, v_cache = inp
         p = _dequant(p, x.dtype)
 
+        # resolved through the pluggable registry (module_registry.py — the
+        # reference's module_registry + heuristics seam). Static per trace:
+        # atom presence and backend are trace-time constants.
+        spec = select_impl("prefill_attn", attn_impl, {
+            "backend": jax.default_backend(),
+            "has_atoms": atom_qidx is not None,
+        })
+
         def attn_fn(y):
             nonlocal k_cache, v_cache
             q, k, v = _qkv(p["attn"], y, cfg, t)
@@ -251,33 +330,14 @@ def ragged_forward(model, params: Any, kv: BlockedKV, tokens, token_seq,
                                            mode="drop")
             v_cache = v_cache.at[dest].set(v.astype(v_cache.dtype),
                                            mode="drop")
-            impl = attn_impl
-            if impl == "auto":
-                impl = ("kernel" if jax.default_backend() == "tpu" else "xla")
-            if impl in ("kernel", "kernel_interpret") and atom_qidx is None:
-                impl = "flash"  # no atom metadata shipped this forward
-            if impl in ("kernel", "kernel_interpret"):
-                # ragged paged-attention kernel (arXiv:2604.15464; reference
-                # blocked_flash + atom_builder): q gathers into fixed-size
-                # single-sequence atoms; KV blocks stream via block-table
-                # DMA — the [S, max_ctx] HBM gather below never happens
-                from ...ops.paged_attention import ragged_prefill_attention
-
-                q_at = q[atom_qidx]                      # [A, BQ, H, D]
-                out_at = ragged_prefill_attention(
-                    q_at, k_cache, v_cache, atom_tables, atom_pos0,
-                    atom_qlen, block_size=bs, alibi=ab, window=window,
-                    impl=("pallas_interpret" if impl == "kernel_interpret"
-                          else "pallas"))
-                flat = out_at.reshape(-1, *out_at.shape[2:])
-                return flat[atom_inv]                    # back to packed rows
-            if impl == "flash":
-                return _packed_flash_attention(q, k_cache, v_cache, token_seq,
-                                               token_pos, block_tables, bs,
-                                               alibi=ab, window=window)
-            return _paged_attention(q, k_cache, v_cache, token_seq,
-                                    token_pos, block_tables, bs,
-                                    alibi=ab, window=window)
+            ctx = PrefillAttnContext(
+                k_cache=k_cache, v_cache=v_cache, token_seq=token_seq,
+                token_pos=token_pos, block_tables=block_tables,
+                block_size=bs, alibi=ab, window=window,
+                atom_qidx=atom_qidx, atom_pos0=atom_pos0,
+                atom_qlen=atom_qlen, atom_tables=atom_tables,
+                atom_inv=atom_inv)
+            return spec.fn(q, ctx)
 
         x = _block(cfg, p, x, attn_fn)
         return x, (k_cache, v_cache)

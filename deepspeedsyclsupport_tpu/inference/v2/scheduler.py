@@ -42,9 +42,11 @@ def schedule_chunks(seqs: Sequence[SequenceDescriptor],
 
     decode = [d for d in seqs if d.needs_tokens == 1 and d.n_cached > 0]
     prefill = [d for d in seqs if d.needs_tokens > 0 and d not in decode]
-    # fairness: starved prompts (older last_scheduled) first; ties keep
-    # arrival (dict) order via the stable sort
-    prefill.sort(key=lambda d: d.last_scheduled)
+    # fairness: least-recently-SERVED prompts first so an in-progress
+    # (chunked) prompt that keeps losing admission races cannot starve;
+    # never-scheduled arrivals rank NEWEST (behind every in-progress
+    # prompt — they hold no KV yet), ties keep arrival order (stable sort)
+    prefill.sort(key=lambda d: (d.last_scheduled < 0, d.last_scheduled))
 
     for d in decode:
         if budget < 1 or len(chunks) >= max_sequences:
@@ -55,7 +57,9 @@ def schedule_chunks(seqs: Sequence[SequenceDescriptor],
         budget -= 1
 
     if chunks and max_prefill_fraction < 1.0:
-        budget = min(budget, int(max_tokens * max_prefill_fraction))
+        # never floor to zero: a tiny fraction must still admit >= 1 prompt
+        # token per forward or waiting prompts starve while decodes run
+        budget = min(budget, max(1, int(max_tokens * max_prefill_fraction)))
     for d in prefill:
         if budget < 1 or len(chunks) >= max_sequences:
             break
