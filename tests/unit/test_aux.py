@@ -442,10 +442,13 @@ class TestBenchLadder:
         monkeypatch.setattr(bench, "_spawn", fake_spawn)
         bench.main()
         rungs = [r for r, _ in seen]
-        assert rungs == ["probe", "kernels", "train", "serve"]
+        # kernels_micro now runs FIRST on TPU (banks compiled-kernel
+        # evidence before anything can hang)
+        assert rungs == ["probe", "kernels_micro", "kernels", "train",
+                         "serve"]
         # kernels timed out → remaining rungs run pinned to CPU
-        assert seen[2][1].get("JAX_PLATFORMS") == "cpu"
         assert seen[3][1].get("JAX_PLATFORMS") == "cpu"
+        assert seen[4][1].get("JAX_PLATFORMS") == "cpu"
         lines = capsys.readouterr().out.strip().splitlines()
         head = _json.loads(lines[-1])
         # aggregated headline: train wins, serve recorded under rungs,
