@@ -49,15 +49,29 @@ PARTITION_COUNT = "partition_count"
 ZERO_STAGE_KEY = "zero_stage"
 
 
-def _install_unpickle_shims() -> None:
-    """Stub the deepspeed classes reference pickles may name, so torch.load
-    of a real checkpoint works without deepspeed installed."""
-    try:
-        import deepspeed  # noqa: F401 — real package present, nothing to do
+import contextlib
 
-        return
+
+@contextlib.contextmanager
+def _unpickle_shims():
+    """TEMPORARILY stub the deepspeed classes reference pickles may name, so
+    torch.load of a real checkpoint works without deepspeed installed.
+
+    Scoped (not persistent): a lingering fake ``deepspeed`` in sys.modules
+    makes ``transformers.is_deepspeed_available()`` true and breaks every
+    subsequent HF import in the process. Unpickled instances keep their
+    (stub) class references after the modules are removed — only the module
+    table is restored."""
+    try:
+        import deepspeed  # noqa: F401 — real package present
+        have_deepspeed = True
     except ImportError:
-        pass
+        have_deepspeed = False
+    if have_deepspeed:
+        # nothing to shim; yield OUTSIDE any try/except so an ImportError
+        # raised by the wrapped body propagates instead of being swallowed
+        yield
+        return
 
     class _Stub:
         def __init__(self, *a, **k):
@@ -74,25 +88,32 @@ def _install_unpickle_shims() -> None:
                                             "tensor_fragment"],
         "deepspeed.runtime.zero.config": ["ZeroStageEnum"],
     }
-    if "deepspeed" not in sys.modules:
-        sys.modules["deepspeed"] = types.ModuleType("deepspeed")
-    for mod_name, classes in shims.items():
+    installed = []
+    names = ["deepspeed"]
+    for mod_name in shims:
         parts = mod_name.split(".")
-        for i in range(2, len(parts) + 1):
-            name = ".".join(parts[:i])
-            if name not in sys.modules:
-                sys.modules[name] = types.ModuleType(name)
+        names.extend(".".join(parts[:i]) for i in range(2, len(parts) + 1))
+    for name in names:
+        if name not in sys.modules:
+            sys.modules[name] = types.ModuleType(name)
+            installed.append(name)
+    for mod_name, classes in shims.items():
         mod = sys.modules[mod_name]
         for cls in classes:
             if not hasattr(mod, cls):
                 setattr(mod, cls, type(cls, (_Stub,), {}))
+    try:
+        yield
+    finally:
+        for name in installed:
+            sys.modules.pop(name, None)
 
 
 def _torch_load(path: str):
     import torch
 
-    _install_unpickle_shims()
-    return torch.load(path, map_location="cpu", weights_only=False)
+    with _unpickle_shims():
+        return torch.load(path, map_location="cpu", weights_only=False)
 
 
 def _to_np(t) -> np.ndarray:
