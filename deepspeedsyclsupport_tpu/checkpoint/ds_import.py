@@ -47,6 +47,18 @@ BASE_OPTIMIZER_STATE = "base_optimizer_state"
 PARAM_SHAPES = "param_shapes"
 PARTITION_COUNT = "partition_count"
 ZERO_STAGE_KEY = "zero_stage"
+# TP merge-rule keys (reference checkpoint/constants.py:54,77-82), stored in
+# the module file by Megatron-DeepSpeed trainers
+UNIVERSAL_CHECKPOINT_INFO = "universal_checkpoint_info"
+TP_REPLICATED = "tp_replicated_parameter_patterns"
+TO_AVERAGE = "parameter_to_average_patterns"
+ROW_PARALLEL = "parameter_with_row_parallelism_patterns"
+VOCAB_PARAMS = "vocabulary_parameter_patterns"
+TWO_SUB_CAT0 = "parameter_with_2_sub_params_cat_dim_0"
+
+_OPTIM_RE = re.compile(
+    r"(?:bf16_|fp16_)?zero_pp_rank_(\d+)_mp_rank_(\d+)" +
+    re.escape(OPTIM_SUFFIX) + r"$")
 
 
 import contextlib
@@ -131,7 +143,12 @@ class DeepSpeedCheckpoint:
     """Inspector over a reference-format checkpoint directory (analog of
     ``deepspeed/checkpoint/deepspeed_checkpoint.py:1``)."""
 
-    def __init__(self, ckpt_dir: str, tag: Optional[str] = None):
+    def __init__(self, ckpt_dir: str, tag: Optional[str] = None,
+                 tp_rules: Optional[Dict[str, Any]] = None):
+        """``tp_rules``: TP merge-rule pattern lists (reference
+        ``universal_checkpoint_info`` keys — tp_replicated/-to-average/
+        row-parallelism/vocabulary/2-sub-params patterns); defaults to the
+        info embedded in the module file when present."""
         self.root = ckpt_dir
         if tag is None:
             latest = os.path.join(ckpt_dir, LATEST)
@@ -159,7 +176,20 @@ class DeepSpeedCheckpoint:
             os.path.join(self.dir, f"*zero_pp_rank_*{OPTIM_SUFFIX}")))
         self.tp_degree = len(self.model_files)
         self._model_sd = [_torch_load(f) for f in self.model_files]
-        self._optim_sd: Optional[List[Dict]] = None
+        # optim files keyed (tp -> dp-ordered paths)
+        self._optim_paths: Dict[int, List[str]] = {}
+        for f in self.optim_files:
+            m = _OPTIM_RE.search(os.path.basename(f))
+            if not m:
+                continue
+            dp, tp = int(m.group(1)), int(m.group(2))
+            self._optim_paths.setdefault(tp, []).append((dp, f))
+        for tp in self._optim_paths:
+            self._optim_paths[tp] = [f for _, f in
+                                     sorted(self._optim_paths[tp])]
+        self._optim_cache: Dict[int, List[Dict]] = {}
+        self._tp_rules = tp_rules if tp_rules is not None else \
+            self._model_sd[0].get(UNIVERSAL_CHECKPOINT_INFO) or {}
 
     # ------------------------------------------------------------ module side
     def module_state_dict(self, tp_rank: int = 0) -> Dict[str, np.ndarray]:
@@ -169,7 +199,12 @@ class DeepSpeedCheckpoint:
 
     @property
     def param_shapes(self) -> List[Dict[str, tuple]]:
-        shapes = self._model_sd[0].get(PARAM_SHAPES)
+        return self.param_shapes_of(0)
+
+    def param_shapes_of(self, tp_rank: int) -> List[Dict[str, tuple]]:
+        """This TP rank's LOCAL param shapes (each rank flattens its own
+        slices; shapes differ across ranks for TP-partitioned params)."""
+        shapes = self._model_sd[tp_rank].get(PARAM_SHAPES)
         if shapes is None:
             raise ValueError(
                 "checkpoint carries no param_shapes — written by a "
@@ -188,16 +223,12 @@ class DeepSpeedCheckpoint:
         return self._model_sd[0].get("ds_version")
 
     # -------------------------------------------------------------- zero side
-    def _load_optim(self) -> List[Dict]:
-        if self._optim_sd is None:
-            if self.tp_degree > 1:
-                raise NotImplementedError(
-                    "ZeRO import with TP-partitioned module files needs "
-                    "per-architecture merge rules; consolidate with the "
-                    "reference's ds_to_universal first")
-            self._optim_sd = [_torch_load(f)[OPTIMIZER_STATE_DICT]
-                              for f in self.optim_files]
-        return self._optim_sd
+    def _load_optim(self, tp_rank: int = 0) -> List[Dict]:
+        if tp_rank not in self._optim_cache:
+            paths = self._optim_paths.get(tp_rank, [])
+            self._optim_cache[tp_rank] = [
+                _torch_load(f)[OPTIMIZER_STATE_DICT] for f in paths]
+        return self._optim_cache[tp_rank]
 
     @property
     def zero_stage(self) -> int:
@@ -209,14 +240,15 @@ class DeepSpeedCheckpoint:
     def dp_degree(self) -> int:
         if not self.optim_files:
             return 1
-        pc = self._load_optim()[0].get(PARTITION_COUNT, len(self.optim_files))
+        # fallback counts ONE tp rank's files — len(optim_files) would be
+        # dp*tp and report a wrong degree for TP>1 checkpoints
+        pc = self._load_optim()[0].get(PARTITION_COUNT,
+                                       len(self._optim_paths.get(0, [])))
         return max(pc) if isinstance(pc, (list, tuple)) else int(pc)
 
-    def _flat_groups(self, key_chain: Callable[[Dict], List]) -> List[List]:
-        """Per-rank list of per-group flat buffers via ``key_chain(sd)``."""
-        return [key_chain(sd) for sd in self._load_optim()]
-
-    def _merge_stage2(self, per_rank_groups: List[List]) -> Dict[str, np.ndarray]:
+    def _merge_stage2(self, per_rank_groups: List[List],
+                      param_shapes: List[Dict[str, tuple]]
+                      ) -> Dict[str, np.ndarray]:
         """Contiguous-partition merge (zero_to_fp32.py:256)."""
         out: Dict[str, np.ndarray] = {}
         n_groups = len(per_rank_groups[0])
@@ -224,7 +256,7 @@ class DeepSpeedCheckpoint:
             flat = np.concatenate([_to_np(r[g]).astype(np.float32).ravel()
                                    for r in per_rank_groups])
             offset = 0
-            for name, shape in self.param_shapes[g].items():
+            for name, shape in param_shapes[g].items():
                 n = int(np.prod(shape)) if shape else 1
                 out[name] = flat[offset:offset + n].reshape(shape)
                 offset += n
@@ -238,11 +270,12 @@ class DeepSpeedCheckpoint:
                     f"— param_shapes do not match the flat partitions")
         return out
 
-    def _merge_stage3(self, per_rank_flat: List[np.ndarray]
+    def _merge_stage3(self, per_rank_flat: List[np.ndarray],
+                      param_shapes: List[Dict[str, tuple]]
                       ) -> Dict[str, np.ndarray]:
         """Interleaved-partition merge (zero_to_fp32.py:390)."""
         world = len(per_rank_flat)
-        shapes = {k: v for group in self.param_shapes
+        shapes = {k: v for group in param_shapes
                   for k, v in group.items()}
         out: Dict[str, np.ndarray] = {}
         offset = 0
@@ -255,58 +288,121 @@ class DeepSpeedCheckpoint:
             offset += per
         return out
 
-    def fp32_state_dict(self) -> Dict[str, np.ndarray]:
-        """Merged full fp32 master weights (the zero_to_fp32 product)."""
-        if not self.optim_files:
-            return {k: v.astype(np.float32)
-                    for k, v in self.module_state_dict().items()}
-        stage = self.zero_stage
-        if stage <= 2:
-            groups = self._flat_groups(lambda sd: sd[SINGLE_PARTITION])
-            return self._merge_stage2(groups)
+    def _zero_fp32_of(self, tp_rank: int) -> Dict[str, np.ndarray]:
+        """One TP rank's dp-merged fp32 master (local TP slices)."""
+        optim = self._load_optim(tp_rank)
+        shapes = self.param_shapes_of(tp_rank)
+        if self.zero_stage <= 2:
+            groups = [sd[SINGLE_PARTITION] for sd in optim]
+            return self._merge_stage2(groups, shapes)
         flats = [np.concatenate([_to_np(t).astype(np.float32).ravel()
                                  for t in sd[FP32_FLAT_GROUPS]])
-                 for sd in self._load_optim()]
-        return self._merge_stage3(flats)
+                 for sd in optim]
+        return self._merge_stage3(flats, shapes)
+
+    def fp32_state_dict(self) -> Dict[str, np.ndarray]:
+        """Merged full fp32 master weights (the zero_to_fp32 product,
+        TP slices merged per the universal-checkpoint rules)."""
+        if not self.optim_files:
+            per_tp = [{k: v.astype(np.float32)
+                       for k, v in self.module_state_dict(t).items()}
+                      for t in range(self.tp_degree)]
+        else:
+            per_tp = [self._zero_fp32_of(t) for t in range(self.tp_degree)]
+        return self._tp_merge(per_tp)
 
     def optimizer_moments(self) -> Dict[str, Dict[str, np.ndarray]]:
         """{'exp_avg': {name: arr}, 'exp_avg_sq': {name: arr}} merged the
         same way the fp32 weights are."""
         if not self.optim_files:
             return {}
-        optim = self._load_optim()
-        base = optim[0].get(BASE_OPTIMIZER_STATE)
-        if not base:
+        if not self._load_optim(0) or \
+                not self._load_optim(0)[0].get(BASE_OPTIMIZER_STATE):
             return {}
         out: Dict[str, Dict[str, np.ndarray]] = {}
         stage = self.zero_stage
         for key in ("exp_avg", "exp_avg_sq"):
             try:
-                if stage <= 2:
-                    per_rank = []
-                    for sd in optim:
-                        b = sd[BASE_OPTIMIZER_STATE]
-                        groups = (b["state"] if isinstance(b, dict)
-                                  and "state" in b else b)
-                        if isinstance(groups, dict):
-                            groups = [groups[k] for k in sorted(groups)]
-                        per_rank.append([g[key] for g in groups])
-                    out[key] = self._merge_stage2(per_rank)
-                else:
-                    flats = []
-                    for sd in optim:
-                        b = sd[BASE_OPTIMIZER_STATE]
-                        groups = (b["state"] if isinstance(b, dict)
-                                  and "state" in b else b)
-                        if isinstance(groups, dict):
-                            groups = [groups[k] for k in sorted(groups)]
-                        flats.append(np.concatenate(
-                            [_to_np(g[key]).astype(np.float32).ravel()
-                             for g in groups]))
-                    out[key] = self._merge_stage3(flats)
+                per_tp = [self._zero_moment_of(t, key, stage)
+                          for t in range(self.tp_degree)]
+                out[key] = self._tp_merge(per_tp)
             except (KeyError, TypeError) as e:
                 logger.warning("moment %s not importable (%s) — optimizer "
                                "state starts fresh", key, e)
+        return out
+
+    def _zero_moment_of(self, tp_rank: int, key: str, stage: int
+                        ) -> Dict[str, np.ndarray]:
+        optim = self._load_optim(tp_rank)
+        shapes = self.param_shapes_of(tp_rank)
+
+        def rank_groups(sd):
+            b = sd[BASE_OPTIMIZER_STATE]
+            groups = (b["state"] if isinstance(b, dict) and "state" in b
+                      else b)
+            if isinstance(groups, dict):
+                groups = [groups[k] for k in sorted(groups)]
+            return groups
+
+        if stage <= 2:
+            per_rank = [[g[key] for g in rank_groups(sd)] for sd in optim]
+            return self._merge_stage2(per_rank, shapes)
+        flats = [np.concatenate([_to_np(g[key]).astype(np.float32).ravel()
+                                 for g in rank_groups(sd)])
+                 for sd in optim]
+        return self._merge_stage3(flats, shapes)
+
+
+    # ------------------------------------------------------------- TP merge
+    def _tp_merge(self, per_tp: List[Dict[str, np.ndarray]]
+                  ) -> Dict[str, np.ndarray]:
+        """Merge one-name-per-dict TP slices into full tensors per the
+        universal-checkpoint pattern rules (reference
+        ``ds_to_universal.merge_tp_slices``, ``checkpoint/
+        ds_to_universal.py:160``): replicated → verify + take first;
+        to-average → mean; 2-sub-params → chunk each slice in two and cat
+        chunk-wise on dim 0 (fused gate/up or kv layouts); row-parallel →
+        cat dim 1; default → cat dim 0; vocabulary params → strip padding
+        to original_vocab_size."""
+        if len(per_tp) == 1:
+            return per_tp[0]
+        rules = self._tp_rules
+        if not rules:
+            raise NotImplementedError(
+                f"TP-partitioned checkpoint (tp={len(per_tp)}) carries no "
+                f"universal_checkpoint_info merge rules — pass tp_rules= "
+                f"(pattern lists: {TP_REPLICATED}, {TO_AVERAGE}, "
+                f"{ROW_PARALLEL}, {VOCAB_PARAMS}, {TWO_SUB_CAT0}) or "
+                f"consolidate with the reference's ds_to_universal first")
+
+        def matched(patterns, name):
+            return any(re.match(p, name) for p in (patterns or []))
+
+        out: Dict[str, np.ndarray] = {}
+        for name in per_tp[0]:
+            slices = [d[name] for d in per_tp]
+            if matched(rules.get(TP_REPLICATED), name):
+                for other in slices[1:]:
+                    if not np.array_equal(slices[0], other):
+                        raise ValueError(
+                            f"{name}: declared TP-replicated but slices "
+                            f"differ across ranks")
+                merged = slices[0]
+            elif matched(rules.get(TO_AVERAGE), name):
+                merged = np.mean(np.stack(slices), axis=0)
+            elif matched(rules.get(TWO_SUB_CAT0), name):
+                halves = [np.split(sl, 2, axis=0) for sl in slices]
+                merged = np.concatenate(
+                    [h[0] for h in halves] + [h[1] for h in halves], axis=0)
+            elif matched(rules.get(ROW_PARALLEL), name):
+                merged = np.concatenate(slices, axis=1)
+            else:
+                merged = np.concatenate(slices, axis=0)
+            if matched(rules.get(VOCAB_PARAMS), name):
+                orig = rules.get("original_vocab_size")
+                if orig:
+                    merged = merged[:int(orig)]
+            out[name] = merged
         return out
 
 
@@ -319,7 +415,9 @@ def load_deepspeed_checkpoint(engine, load_dir: str,
                               tag: Optional[str] = None,
                               name_map: Optional[Callable[[str], str]] = None,
                               load_optimizer_states: bool = True,
-                              strict: bool = True) -> str:
+                              strict: bool = True,
+                              tp_rules: Optional[Dict[str, Any]] = None
+                              ) -> str:
     """Import a reference-format checkpoint into a live engine
     (the migration analog of ``engine.load_checkpoint``,
     reference ``runtime/engine.py:2688``).
@@ -330,7 +428,7 @@ def load_deepspeed_checkpoint(engine, load_dir: str,
                                          safe_set_full_fp32_param,
                                          safe_set_full_optimizer_state)
 
-    ckpt = DeepSpeedCheckpoint(load_dir, tag)
+    ckpt = DeepSpeedCheckpoint(load_dir, tag, tp_rules=tp_rules)
     nm = name_map or default_name_map
     known = set(param_paths(engine.params))
     fp32 = ckpt.fp32_state_dict()

@@ -357,7 +357,7 @@ class InferenceEngineV2:
         cfg = self.config
         if self._decode_forward is None:
             self._decode_forward = build_decode_forward_fn(
-                self.model, cfg.block_size)
+                self.model, cfg.block_size, attn_impl=cfg.decode_attn)
         s_max = cfg.max_sequences
         tokens = np.zeros((s_max,), np.int32)
         positions = np.zeros((s_max,), np.int32)

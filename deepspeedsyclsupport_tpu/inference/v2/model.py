@@ -283,6 +283,38 @@ def _prefill_xla_impl(q, ctx: PrefillAttnContext):
                             alibi=ctx.alibi, window=ctx.window)
 
 
+# decode_attn kind: one-token-per-slot steady state (the reference's
+# blocked_flash decode path) — the same registry surface as prefill
+def _decode_dispatch(impl_name):
+    def fn(q, ctx):
+        from ...ops.paged_attention import paged_decode_attention
+
+        return paged_decode_attention(
+            q, ctx.k_cache, ctx.v_cache, ctx.block_tables, ctx.seq_lens,
+            block_size=ctx.block_size, impl=impl_name, alibi=ctx.alibi,
+            window=ctx.window)
+    return fn
+
+
+class DecodeAttnContext(NamedTuple):
+    k_cache: Any
+    v_cache: Any
+    block_tables: Any
+    seq_lens: Any
+    block_size: int
+    alibi: Any
+    window: Optional[int]
+
+
+register_impl("decode_attn", "pallas", priority=10,
+              auto_eligible=lambda c: c.get("backend") == "tpu")(
+    _decode_dispatch("pallas"))
+register_impl("decode_attn", "pallas_interpret", priority=-10,
+              auto_eligible=lambda c: False)(
+    _decode_dispatch("pallas_interpret"))
+register_impl("decode_attn", "xla", priority=0)(_decode_dispatch("xla"))
+
+
 def ragged_forward(model, params: Any, kv: BlockedKV, tokens, token_seq,
                    token_pos, block_tables, last_tok_idx,
                    atom_qidx=None, atom_pos0=None, atom_qlen=None,
@@ -369,8 +401,6 @@ def decode_forward(model, params: Any, kv: BlockedKV, tokens, positions,
     serving spends most of its life in, so it gets the kernel; mixed
     prefill+decode batches take :func:`ragged_forward`.
     """
-    from ...ops.paged_attention import paged_decode_attention
-
     cfg = model.config
     bs = block_size
     num_slots = kv.num_slots
@@ -388,6 +418,9 @@ def decode_forward(model, params: Any, kv: BlockedKV, tokens, positions,
         p, k_cache, v_cache = inp
         p = _dequant(p, x.dtype)
 
+        spec = select_impl("decode_attn", attn_impl,
+                           {"backend": jax.default_backend()})
+
         def attn_fn(y):
             nonlocal k_cache, v_cache
             q, k, v = _qkv(p["attn"], y, cfg, s)
@@ -396,10 +429,9 @@ def decode_forward(model, params: Any, kv: BlockedKV, tokens, positions,
                                            mode="drop")
             v_cache = v_cache.at[dest].set(v.astype(v_cache.dtype),
                                            mode="drop")
-            return paged_decode_attention(q, k_cache, v_cache, block_tables,
-                                          seq_lens, block_size=bs,
-                                          impl=attn_impl, alibi=ab,
-                                          window=window)
+            return spec.fn(q, DecodeAttnContext(
+                k_cache=k_cache, v_cache=v_cache, block_tables=block_tables,
+                seq_lens=seq_lens, block_size=bs, alibi=ab, window=window))
 
         x = _block(cfg, p, x, attn_fn)
         return x, (k_cache, v_cache)

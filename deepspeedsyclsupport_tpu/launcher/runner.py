@@ -135,9 +135,12 @@ def _command(args, env: Dict[str, str]) -> List[str]:
 
 
 def main(argv=None) -> int:
+    # allow_abbrev=False: the elastic branch re-invokes this launcher with
+    # the elastic flags STRIPPED by exact name — an abbreviated flag
+    # (--elastic) would survive the strip and recurse the agent forever
     p = argparse.ArgumentParser(
         prog="dstpu", description="deepspeedsyclsupport_tpu launcher "
-        "(reference: the `deepspeed` CLI)")
+        "(reference: the `deepspeed` CLI)", allow_abbrev=False)
     p.add_argument("--hostfile", default=None)
     p.add_argument("--num_nodes", "-N", type=int, default=1)
     p.add_argument("--num_procs", type=int, default=1,
@@ -153,6 +156,16 @@ def main(argv=None) -> int:
                         "(reference launcher/multinode_runner.py)")
     p.add_argument("--launcher_args", default="",
                    help="extra flags passed through to the backend verbatim")
+    p.add_argument("--elastic_training", action="store_true",
+                   help="supervise under the elastic agent: re-discover "
+                        "membership and restart on worker failure "
+                        "(reference --elastic_training)")
+    p.add_argument("--min_elastic_nodes", type=int, default=1)
+    p.add_argument("--max_elastic_nodes", type=int, default=-1)
+    p.add_argument("--deepspeed_config", default=None,
+                   help="JSON config consulted by the elastic agent for "
+                        "the elasticity batch math (also reachable from "
+                        "user_args)")
     p.add_argument("--bind_cores_to_rank", action="store_true",
                    help="numactl-bind each local rank to its core slice "
                         "(reference --bind_cores_to_rank)")
@@ -163,6 +176,50 @@ def main(argv=None) -> int:
     p.add_argument("user_script")
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
+
+    if args.elastic_training:
+        # wrap THIS launcher invocation (minus the elastic flags) under the
+        # restart-supervising agent (reference: DSElasticAgent via
+        # launcher/runner.py --elastic_training, elasticity/elastic_agent.py)
+        import json as _json
+
+        from ..elasticity.elastic_agent import DSElasticAgent
+
+        raw = list(argv) if argv is not None else sys.argv[1:]
+        inner, skip = [], False
+        for tok in raw:
+            if skip:
+                skip = False
+                continue
+            if tok == "--elastic_training":
+                continue
+            if tok in ("--min_elastic_nodes", "--max_elastic_nodes"):
+                skip = True
+                continue
+            if tok.startswith(("--min_elastic_nodes=",
+                               "--max_elastic_nodes=")):
+                continue
+            inner.append(tok)
+        cfg_path = args.deepspeed_config
+        if cfg_path is None:
+            for i, tok in enumerate(args.user_args):
+                if tok == "--deepspeed_config" and \
+                        i + 1 < len(args.user_args):
+                    cfg_path = args.user_args[i + 1]
+                    break
+                if tok.startswith("--deepspeed_config="):
+                    cfg_path = tok.split("=", 1)[1]
+                    break
+        ds_config = {}
+        if cfg_path:
+            with open(cfg_path) as f:
+                ds_config = _json.load(f)
+        agent = DSElasticAgent(
+            [sys.executable, "-m",
+             "deepspeedsyclsupport_tpu.launcher.runner"] + inner,
+            ds_config, min_nodes=args.min_elastic_nodes,
+            max_nodes=args.max_elastic_nodes, hostfile=args.hostfile)
+        return agent.run()
 
     if args.launcher != "ssh":
         from .multinode_runner import build_runner

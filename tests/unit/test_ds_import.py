@@ -155,6 +155,110 @@ class TestInspector:
                 np.testing.assert_allclose(got[key][n], mom[key][n])
 
 
+class TestTPMerge:
+    def _write_tp_checkpoint(self, root, tp_named, rules, dp=2):
+        """Per-TP-rank module + zero files (the Megatron-DeepSpeed layout:
+        each TP rank flattens and dp-partitions its LOCAL slices)."""
+        d = os.path.join(root, "t")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(root, "latest"), "w") as f:
+            f.write("t")
+        for tp, named in enumerate(tp_named):
+            module = {n: torch.from_numpy(a) for n, a in named}
+            torch.save({
+                "module": module,
+                "buffer_names": [],
+                "param_shapes": [{n: torch.Size(a.shape) for n, a in named}],
+                "shared_params": {},
+                "ds_version": "0.12.7",
+                "global_steps": 3,
+                "universal_checkpoint_info": rules,
+            }, os.path.join(d, f"mp_rank_{tp:02d}_model_states.pt"))
+            flat = np.concatenate([a.astype(np.float32).ravel()
+                                   for _, a in named])
+            align = 2 * dp
+            padded = int(-(-len(flat) // align) * align)
+            per = padded // dp
+            for r in range(dp):
+                v = np.zeros(padded, np.float32)
+                v[:len(flat)] = flat
+                osd = {"zero_stage": 2, "partition_count": dp,
+                       "single_partition_of_fp32_groups":
+                           [torch.from_numpy(v[r * per:(r + 1) * per].copy())],
+                       "base_optimizer_state": {"state": {}, "param_groups": []}}
+                torch.save({"optimizer_state_dict": osd},
+                           os.path.join(d, f"bf16_zero_pp_rank_{r}_mp_rank_"
+                                           f"{tp:02d}_optim_states.pt"))
+        return root
+
+    def test_tp2_merge_rules(self, tmp_path):
+        """Column (cat0), row (cat1), replicated, averaged, vocab-padded,
+        and 2-sub-param layouts across 2 TP ranks — the reference's
+        merge_tp_slices semantics (ds_to_universal.py:160)."""
+        rng = np.random.RandomState(0)
+        col = rng.randn(8, 4).astype(np.float32)     # cat dim 0
+        row = rng.randn(4, 6).astype(np.float32)     # cat dim 1
+        rep = rng.randn(5).astype(np.float32)        # replicated
+        avg = rng.randn(3).astype(np.float32)        # averaged
+        vocab = rng.randn(10, 4).astype(np.float32)  # padded to 12 rows
+        vocab_pad = np.concatenate([vocab, np.zeros((2, 4), np.float32)])
+        fused = rng.randn(8, 4).astype(np.float32)   # 2 sub-params cat0
+        f_halves = np.split(fused, 2, axis=0)        # [gate, up]
+        tp_named = []
+        for t in range(2):
+            tp_named.append([
+                ("attn.wq", np.ascontiguousarray(
+                    np.split(col, 2, axis=0)[t])),
+                ("attn.wo", np.ascontiguousarray(
+                    np.split(row, 2, axis=1)[t])),
+                ("norm.scale", rep),
+                ("head.avg", avg + (0.5 if t else -0.5)),
+                ("embed.word", np.ascontiguousarray(
+                    np.split(vocab_pad, 2, axis=0)[t])),
+                ("mlp.gate_up", np.concatenate(
+                    [np.split(f_halves[0], 2, axis=0)[t],
+                     np.split(f_halves[1], 2, axis=0)[t]])),
+            ])
+        rules = {
+            "tp_replicated_parameter_patterns": [r"norm\."],
+            "parameter_to_average_patterns": [r"head\.avg"],
+            "parameter_with_row_parallelism_patterns": [r"attn\.wo"],
+            "vocabulary_parameter_patterns": [r"embed\.word"],
+            "parameter_with_2_sub_params_cat_dim_0": [r"mlp\.gate_up"],
+            "original_vocab_size": 10,
+        }
+        self._write_tp_checkpoint(str(tmp_path), tp_named, rules)
+        ck = DeepSpeedCheckpoint(str(tmp_path))
+        assert ck.tp_degree == 2 and ck.dp_degree == 2
+        sd = ck.fp32_state_dict()
+        np.testing.assert_allclose(sd["attn.wq"], col)
+        np.testing.assert_allclose(sd["attn.wo"], row)
+        np.testing.assert_allclose(sd["norm.scale"], rep)
+        np.testing.assert_allclose(sd["head.avg"], avg, atol=1e-6)
+        np.testing.assert_allclose(sd["embed.word"], vocab)  # padding gone
+        np.testing.assert_allclose(sd["mlp.gate_up"], fused)
+
+    def test_tp_without_rules_raises_with_guidance(self, tmp_path):
+        tp_named = [[("w", np.ones((2, 2), np.float32))] for _ in range(2)]
+        self._write_tp_checkpoint(str(tmp_path), tp_named, rules=None)
+        ck = DeepSpeedCheckpoint(str(tmp_path))
+        with pytest.raises(NotImplementedError, match="tp_rules"):
+            ck.fp32_state_dict()
+        # explicit rules unblock it (everything defaults to cat dim 0)
+        ck2 = DeepSpeedCheckpoint(str(tmp_path),
+                                  tp_rules={"dummy": []})
+        assert ck2.fp32_state_dict()["w"].shape == (4, 2)
+
+    def test_replicated_mismatch_detected(self, tmp_path):
+        tp_named = [[("norm.scale", np.full(3, float(t), np.float32))]
+                    for t in range(2)]
+        rules = {"tp_replicated_parameter_patterns": [r"norm\."]}
+        self._write_tp_checkpoint(str(tmp_path), tp_named, rules)
+        ck = DeepSpeedCheckpoint(str(tmp_path))
+        with pytest.raises(ValueError, match="replicated"):
+            ck.fp32_state_dict()
+
+
 class TestEngineImport:
     def _roundtrip(self, tmp_path, stage, dp):
         """Engine A trains → its state written in reference layout →

@@ -35,6 +35,16 @@ class TestRegistryMechanics:
         assert select_impl("prefill_attn", "kernel_interpret",
                            {"has_atoms": True}).name == "kernel_interpret"
 
+    def test_decode_kind_registered_with_heuristics(self):
+        import deepspeedsyclsupport_tpu.inference.v2.model  # noqa: F401
+
+        assert {"pallas", "pallas_interpret", "xla"} <= set(
+            list_impls("decode_attn"))
+        assert select_impl("decode_attn", "auto",
+                           {"backend": "cpu"}).name == "xla"
+        assert select_impl("decode_attn", "auto",
+                           {"backend": "tpu"}).name == "pallas"
+
     def test_unknown_name_lists_registered(self):
         with pytest.raises(KeyError, match="registered"):
             get_impl("prefill_attn", "warp-drive")

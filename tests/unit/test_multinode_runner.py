@@ -115,6 +115,47 @@ class TestCLIIntegration:
             main(["--hostfile", str(hf), "--launcher", "pdsh", "train.py"])
 
 
+class TestElasticWiring:
+    def test_elastic_training_wraps_launcher_under_agent(self, tmp_path,
+                                                         monkeypatch):
+        """--elastic_training supervises the launcher itself under
+        DSElasticAgent (reference launcher/runner.py --elastic_training):
+        the inner command strips the elastic flags, config flows to the
+        batch math, min/max nodes reach the agent."""
+        import json
+
+        from deepspeedsyclsupport_tpu.launcher import runner as runner_mod
+
+        cfg = tmp_path / "ds.json"
+        cfg.write_text(json.dumps({"elasticity": {"enabled": False}}))
+        captured = {}
+
+        class FakeAgent:
+            def __init__(self, cmd, ds_config, **kw):
+                captured.update(cmd=cmd, ds_config=ds_config, **kw)
+
+            def run(self):
+                return 0
+
+        import deepspeedsyclsupport_tpu.elasticity.elastic_agent as ea
+
+        monkeypatch.setattr(ea, "DSElasticAgent", FakeAgent)
+        rc = runner_mod.main([
+            "--elastic_training", "--min_elastic_nodes", "2",
+            "--max_elastic_nodes", "8", "--deepspeed_config", str(cfg),
+            "--num_nodes", "1", "--dry_run", "train.py", "--lr", "1e-4"])
+        assert rc == 0
+        inner = captured["cmd"]
+        assert inner[:3] == [__import__("sys").executable, "-m",
+                             "deepspeedsyclsupport_tpu.launcher.runner"]
+        tail = inner[3:]
+        assert "--elastic_training" not in tail
+        assert "--min_elastic_nodes" not in tail and "2" not in tail[:1]
+        assert "train.py" in tail and "--lr" in tail
+        assert captured["min_nodes"] == 2 and captured["max_nodes"] == 8
+        assert captured["ds_config"] == {"elasticity": {"enabled": False}}
+
+
 class TestConsoleScripts:
     """The [project.scripts] contract (reference installs bin/deepspeed and
     bin/ds_report): entry points must resolve and run without installation."""
