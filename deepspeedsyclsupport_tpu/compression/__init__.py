@@ -2,3 +2,4 @@ from .quantize import (QuantConfig, dequantize_int8, fake_quant,  # noqa: F401
                        quantize_int8)
 from .compress import (apply_layer_reduction, compress,  # noqa: F401
                        get_compression_config)
+from .qat import QATScheduler, apply_qat, parse_qat_config  # noqa: F401

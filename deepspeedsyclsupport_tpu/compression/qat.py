@@ -1,0 +1,140 @@
+"""Quantization-aware training with a progressive bit schedule.
+
+Analog of the reference's training-time quantizer
+(``deepspeed/runtime/quantize.py:14`` ``Quantizer`` + the
+``compression_training.weight_quantization`` config surface,
+``compression/constants.py``): weights train against their quantized
+values, and precision anneals — starting at ``start_bits``, dropping one
+bit each time the (doubling) quantization period elapses until
+``target_bits`` (``compute_quantization``, ``runtime/quantize.py:129``).
+
+The torch reference mutates the fp16 weight copies between steps; here the
+fp32 master stays exact and the per-forward COMPUTE copy is fake-quantized
+with straight-through gradients (``quantize.fake_quant``) — the same
+training dynamics, no weight mutation. Bit changes are trace-time
+constants: each drop recompiles the step once (the random-LTD pattern).
+"""
+import fnmatch
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .quantize import fake_quant
+from ..utils.logging import log_dist
+
+__all__ = ["QATScheduler", "parse_qat_config", "apply_qat"]
+
+
+@dataclass
+class _Group:
+    modules: List[str]
+    start_bits: int
+    target_bits: int
+    period: int          # steps until the next one-bit drop (doubles)
+    current_bits: int = 0
+    next_drop: int = 0   # absolute step of the next drop
+
+    def __post_init__(self):
+        self.current_bits = self.start_bits
+
+
+@dataclass
+class QATScheduler:
+    """Progressive precision schedule over parameter groups."""
+    groups: List[_Group]
+    schedule_offset: int = 0
+    symmetric: bool = True
+    verbose: bool = False
+    _started: bool = field(default=False, repr=False)
+
+    def update(self, step: int) -> Tuple[Dict[int, int], bool]:
+        """Advance to ``step``; returns ({group-index: bits}, changed)."""
+        changed = False
+        if step >= self.schedule_offset and not self._started:
+            self._started = True
+            changed = True  # quantization switches ON this step
+            for g in self.groups:
+                g.next_drop = step + g.period
+        if self._started:
+            for g in self.groups:
+                while (g.current_bits > g.target_bits
+                       and step >= g.next_drop):
+                    g.current_bits -= 1
+                    g.period *= 2  # reference: input.q_period <<= 1
+                    g.next_drop = step + g.period
+                    changed = True
+                    if self.verbose:
+                        log_dist(f"QAT: group {g.modules} -> "
+                                 f"{g.current_bits} bits (period "
+                                 f"{g.period}) at step {step}")
+        bits = ({i: g.current_bits for i, g in enumerate(self.groups)}
+                if self._started else {})
+        return bits, changed
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"started": self._started,
+                "groups": [(g.current_bits, g.period, g.next_drop)
+                           for g in self.groups]}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self._started = bool(sd["started"])
+        for g, (bits, period, nxt) in zip(self.groups, sd["groups"]):
+            g.current_bits, g.period, g.next_drop = int(bits), int(period), \
+                int(nxt)
+
+
+def parse_qat_config(raw: Dict[str, Any]) -> Optional[QATScheduler]:
+    """``compression_training.weight_quantization`` with
+    ``quantize_weight_in_forward`` → a scheduler (None when absent/off).
+    Reference keys: shared_parameters {enabled, quantize_weight_in_forward,
+    schedule_offset, quantize_verbose, quantization_type}; different_groups
+    params {start_bits, target_bits, quantization_period}."""
+    wq = dict(dict(raw.get("compression_training", {}))
+              .get("weight_quantization", {}))
+    shared = dict(wq.get("shared_parameters", {}))
+    if not shared.get("enabled", False) or \
+            not shared.get("quantize_weight_in_forward", False):
+        return None
+    groups = []
+    for g in map(dict, dict(wq.get("different_groups", {})).values()):
+        p = dict(g.get("params", {}))
+        groups.append(_Group(
+            modules=list(g.get("modules", ["*"])),
+            start_bits=int(p.get("start_bits", 16)),
+            target_bits=int(p.get("target_bits", 8)),
+            period=int(p.get("quantization_period", 1000) or 1)))
+    if not groups:
+        groups = [_Group(modules=["*"], start_bits=16, target_bits=8,
+                         period=1000)]
+    return QATScheduler(
+        groups=groups,
+        schedule_offset=int(shared.get("schedule_offset", 0)),
+        symmetric=str(shared.get("quantization_type",
+                                 "symmetric")) != "asymmetric",
+        verbose=bool(shared.get("quantize_verbose", False)))
+
+
+def apply_qat(params: Any, bits_by_group: Dict[int, int],
+              groups: List[_Group], symmetric: bool = True) -> Any:
+    """STE fake-quantize matching >=2-D weight leaves at their group's
+    current bits (first matching group wins, reference group semantics).
+    Bits are PYTHON ints — trace-time constants."""
+    if not bits_by_group:
+        return params
+
+    def visit(path, leaf):
+        if not isinstance(leaf, jnp.ndarray) or leaf.ndim < 2 or \
+                not jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in path)
+        for i, g in enumerate(groups):
+            if any(fnmatch.fnmatch(name, pat) or pat in name
+                   for pat in g.modules):
+                return fake_quant(leaf, bits_by_group[i],
+                                  symmetric=symmetric)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(visit, params)

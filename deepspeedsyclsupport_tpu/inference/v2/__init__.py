@@ -17,3 +17,28 @@ scheduling:
 from .config import RaggedInferenceConfig  # noqa: F401
 from .engine_v2 import InferenceEngineV2  # noqa: F401
 from .ragged import BlockedAllocator, RaggedBatch, SequenceDescriptor  # noqa: F401
+
+
+def build_hf_engine(path: str, **config) -> "InferenceEngineV2":
+    """FastGen entry point over a local HF checkpoint directory (reference
+    ``inference/v2/engine_factory.py:123`` ``build_hf_engine``: HF name →
+    policy → engine): loads the checkpoint through the per-family ingestion
+    maps (``checkpoint/hf.py``) and serves it with the ragged engine.
+    Engine knobs (max_tokens_per_batch, block_size, ...) ride ``config``."""
+    import os
+
+    if not os.path.isdir(path):
+        raise FileNotFoundError(
+            f"build_hf_engine expects a local checkpoint directory, got "
+            f"{path!r} (hub names are not downloaded here)")
+    from ...checkpoint.hf import load_hf_checkpoint
+
+    import jax.numpy as jnp
+
+    dtype = config.pop("dtype", "bfloat16")
+    model, params = load_hf_checkpoint(path, dtype=dtype)
+    # the model's compute-dtype hint follows the serving dtype (load casts
+    # the params; the config drives activation dtypes)
+    model.config.dtype = jnp.dtype(dtype).name if not isinstance(dtype, str) \
+        else dtype
+    return InferenceEngineV2(model, params, config=config, dtype=dtype)

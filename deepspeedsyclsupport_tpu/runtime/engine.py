@@ -403,6 +403,15 @@ class Engine:
                     "model.loss itself) or read config from the engine's "
                     "module", sorted(mcfg_overrides))
             self.module = view
+        # --------------------------------------------------- QAT (in-forward)
+        # reference runtime/quantize.py Quantizer: progressive bit schedule
+        # over weight groups; compute copies are STE-fake-quantized in the
+        # forward while the fp32 master stays exact
+        from ..compression.qat import parse_qat_config
+
+        self.qat_scheduler = parse_qat_config(self.config.raw)
+        self._qat_bits: Dict[int, int] = {}
+
         from ..profiling.flops_profiler import FlopsProfiler
 
         self.flops_profiler = FlopsProfiler(self)
@@ -617,13 +626,21 @@ class Engine:
             params)
 
     def _loss_and_metrics(self, params, batch, rng, train=True):
+        p = self._cast_params(params)
+        if self.qat_scheduler is not None and self._qat_bits:
+            # eval included: QAT's point is measuring at deployment
+            # precision (reference quantize_weight_in_forward quantizes the
+            # module forward unconditionally)
+            from ..compression.qat import apply_qat
+
+            p = apply_qat(p, self._qat_bits, self.qat_scheduler.groups,
+                          self.qat_scheduler.symmetric)
         if self._loss_accepts_train:
-            out = self.loss_fn_raw(self._cast_params(params), batch, rng,
-                                   train=train)
+            out = self.loss_fn_raw(p, batch, rng, train=train)
         else:
             # user loss fns without a train flag (no train-time stochastic
             # behavior to gate)
-            out = self.loss_fn_raw(self._cast_params(params), batch, rng)
+            out = self.loss_fn_raw(p, batch, rng)
         if isinstance(out, tuple):
             loss, metrics = out
             metrics = dict(metrics)
@@ -762,6 +779,11 @@ class Engine:
                 self._rltd_value = v
                 self.module.config.random_ltd_current = v
                 self._train_batch_fn = None  # retrace at the new keep count
+        if self.qat_scheduler is not None:
+            bits, changed = self.qat_scheduler.update(self.global_steps)
+            if changed:
+                self._qat_bits = bits
+                self._train_batch_fn = None  # retrace at the new precision
         if self._train_batch_fn is None and self.offload_device is None:
             self._train_batch_fn = self._build_train_batch_fn()
         gas = self.config.gradient_accumulation_steps
@@ -1060,6 +1082,8 @@ class Engine:
             meta["curriculum"] = self.curriculum_scheduler.state_dict()
         if self.random_ltd_scheduler is not None:
             meta["random_ltd"] = self.random_ltd_scheduler.state_dict()
+        if self.qat_scheduler is not None:
+            meta["qat"] = self.qat_scheduler.state_dict()
         self.checkpoint_engine.save(
             path, state, meta,
             latest_file=(os.path.join(save_dir, LATEST_FILE)
@@ -1150,6 +1174,10 @@ class Engine:
             self.curriculum_scheduler.load_state_dict(meta["curriculum"])
         if self.random_ltd_scheduler is not None and "random_ltd" in meta:
             self.random_ltd_scheduler.load_state_dict(meta["random_ltd"])
+        if self.qat_scheduler is not None and "qat" in meta:
+            self.qat_scheduler.load_state_dict(meta["qat"])
+            self._qat_bits, _ = self.qat_scheduler.update(self.global_steps)
+            self._train_batch_fn = None  # retrace at the restored precision
         # skipped_steps rides in scaler_state.overflows, restored above
         log_dist(f"loaded checkpoint {path}")
         return path, meta.get("client_state", {})

@@ -291,3 +291,36 @@ class TestEnginesServeRealWeights:
                              max_seq_len=64)
         logits = eng(jnp.asarray([[1, 2, 3]], jnp.int32))
         assert logits.shape == (1, 3, VOCAB)
+
+
+class TestV2Factory:
+    def test_build_hf_engine_serves_checkpoint(self, tmp_path):
+        """FastGen entry point (reference engine_factory.build_hf_engine):
+        local HF dir → ragged v2 engine, logits matching the dense model."""
+        import numpy as np
+
+        from deepspeedsyclsupport_tpu.checkpoint.hf import load_hf_checkpoint
+        from deepspeedsyclsupport_tpu.inference.v2 import build_hf_engine
+
+        fabricate_hf_checkpoint(str(tmp_path))
+        eng = build_hf_engine(str(tmp_path), dtype="float32",
+                              max_tokens_per_batch=16, block_size=8,
+                              max_context=64, max_sequences=4)
+        prompt = [1, 5, 9, 2]
+        out = eng.put([1], [prompt])
+        assert 1 in out
+        model, params = load_hf_checkpoint(str(tmp_path), dtype="float32")
+        model.config.dtype = "float32"  # compute at the comparison dtype
+        import jax.numpy as jnp
+
+        dense = model.apply(params, jnp.asarray([prompt], jnp.int32))
+        np.testing.assert_allclose(out[1], np.asarray(dense[0, -1]),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_rejects_non_directory(self):
+        import pytest as _p
+
+        from deepspeedsyclsupport_tpu.inference.v2 import build_hf_engine
+
+        with _p.raises(FileNotFoundError, match="local checkpoint"):
+            build_hf_engine("org/model-name")
