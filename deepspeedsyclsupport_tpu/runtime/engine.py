@@ -411,10 +411,23 @@ class Engine:
 
         self.qat_scheduler = parse_qat_config(self.config.raw)
         self._qat_bits: Dict[int, int] = {}
+        if self.qat_scheduler is not None:
+            # sync NOW: eval_batch/forward before the first train_batch must
+            # already see the step-0 precision
+            self._qat_bits, _ = self.qat_scheduler.update(0)
 
         from ..profiling.flops_profiler import FlopsProfiler
 
         self.flops_profiler = FlopsProfiler(self)
+        # XLA timeline capture (the reference's NVTX-range story,
+        # ``utils/nvtx.py`` + wall_clock_breakdown, recast as jax.profiler
+        # traces viewable in TensorBoard/Perfetto): config section
+        # {"jax_profiler": {"enabled": true, "trace_dir": ..., "start_step":
+        # N, "num_steps": M}} brackets M train steps with a device trace
+        jp = dict(self.config.raw.get("jax_profiler", {}))
+        self._trace_cfg = jp if jp.get("enabled") else None
+        self._tracing = False
+        self._trace_origin = None  # "config" windows auto-stop; manual don't
         self.losses = None
 
     # ================================================================ offload
@@ -783,7 +796,10 @@ class Engine:
             bits, changed = self.qat_scheduler.update(self.global_steps)
             if changed:
                 self._qat_bits = bits
-                self._train_batch_fn = None  # retrace at the new precision
+                # every cached program bakes the bits in: retrace them all
+                self._train_batch_fn = None
+                self._eval_fn = None
+                self._grad_fn = None
         if self._train_batch_fn is None and self.offload_device is None:
             self._train_batch_fn = self._build_train_batch_fn()
         gas = self.config.gradient_accumulation_steps
@@ -801,6 +817,10 @@ class Engine:
             batch = {**batch,
                      "pld_theta": jnp.broadcast_to(t, (gas,)) if gas > 1
                      else t}
+        if self._trace_cfg is not None and not self._tracing and \
+                self.global_steps == int(self._trace_cfg.get("start_step", 1)):
+            self.start_profile()
+            self._trace_origin = "config"
         self.tput_timer.start()
         rng = jax.random.fold_in(self._rng, self.global_steps)
         t_step = time.perf_counter()
@@ -834,6 +854,14 @@ class Engine:
                                      time.perf_counter() - t_step)
         self.global_steps += 1
         self.micro_steps += gas
+        if self._tracing and self._trace_origin == "config":
+            start = int(self._trace_cfg.get("start_step", 1))
+            n = int(self._trace_cfg.get("num_steps", 3))
+            # close INSIDE the last in-window call — a loop that ends with
+            # the window would otherwise exit with the trace open and no
+            # artifacts written
+            if self.global_steps >= start + n:
+                self.stop_profile()
         if (self.config.flops_profiler.enabled and self.offload_device is None
                 and getattr(self, "_train_batch_raw", None) is not None):
             # post-donation the old state is gone; new state has identical
@@ -843,6 +871,31 @@ class Engine:
                 (self.params, self.opt_state, self.scaler_state, batch, rng))
         self._post_step(metrics)
         return metrics
+
+    def start_profile(self, trace_dir: Optional[str] = None) -> None:
+        """Begin an XLA device-timeline capture (jax.profiler trace —
+        TensorBoard/Perfetto-viewable; the role NVTX ranges + nsys play for
+        the reference). Also usable manually around any region."""
+        if self._tracing:
+            return
+        trace_dir = trace_dir or (self._trace_cfg or {}).get(
+            "trace_dir") or os.path.join(os.getcwd(), "dstpu_traces")
+        jax.profiler.start_trace(trace_dir)
+        self._tracing = True
+        self._trace_origin = "manual"  # train_batch overrides for windows
+        import atexit
+
+        atexit.register(self.stop_profile)  # never exit with an open trace
+        log_dist(f"jax.profiler trace started -> {trace_dir}")
+
+    def stop_profile(self) -> None:
+        if not self._tracing:
+            return
+        jax.block_until_ready(jax.tree_util.tree_leaves(self.params)[:1])
+        jax.profiler.stop_trace()
+        self._tracing = False
+        self._trace_origin = None
+        log_dist("jax.profiler trace stopped")
 
     def xla_comms_summary(self, log: bool = True,
                           show_straggler: bool = False) -> Dict[str, Dict]:
@@ -1178,6 +1231,8 @@ class Engine:
             self.qat_scheduler.load_state_dict(meta["qat"])
             self._qat_bits, _ = self.qat_scheduler.update(self.global_steps)
             self._train_batch_fn = None  # retrace at the restored precision
+            self._eval_fn = None
+            self._grad_fn = None
         # skipped_steps rides in scaler_state.overflows, restored above
         log_dist(f"loaded checkpoint {path}")
         return path, meta.get("client_state", {})

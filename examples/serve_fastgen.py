@@ -1,0 +1,54 @@
+"""Serve with the ragged (FastGen-class) v2 engine.
+
+    python examples/serve_fastgen.py                      # built-in tiny model
+    python examples/serve_fastgen.py --hf /ckpts/llama-2-7b-hf
+"""
+import argparse
+
+import jax
+import numpy as np
+
+from deepspeedsyclsupport_tpu.inference.v2 import (InferenceEngineV2,
+                                                   build_hf_engine)
+from deepspeedsyclsupport_tpu.models import build_model
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--hf", default=None,
+                   help="local HF checkpoint directory (reference "
+                        "build_hf_engine entry point)")
+    p.add_argument("--max_new_tokens", type=int, default=16)
+    args = p.parse_args()
+
+    if args.hf:
+        eng = build_hf_engine(args.hf, max_tokens_per_batch=768,
+                              block_size=64, max_context=2048)
+    else:
+        model = build_model("tiny")
+        eng = InferenceEngineV2(model, model.init_params(),
+                                max_tokens_per_batch=64, block_size=16,
+                                max_context=128, max_sequences=8,
+                                max_prefill_fraction=0.75,
+                                eviction_policy="lru")
+    eng.warmup()
+
+    # low-level contract: put/query/flush at single-forward granularity
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 500, size=n).tolist() for n in (12, 30, 7)]
+    out = eng.put(list(range(len(prompts))), prompts)
+    print("admitted:", out.admission.admitted,
+          "rejected:", dict(out.admission.reasons))
+    for uid in out:
+        print(f"uid {uid}: first sampled token "
+              f"{int(np.argmax(out[uid]))}")
+    eng.flush(list(range(len(prompts))))
+
+    # high-level continuous batching
+    outs = eng.generate(prompts, max_new_tokens=args.max_new_tokens)
+    for i, toks in enumerate(outs):
+        print(f"prompt {i} -> {len(toks)} new tokens: {toks[:10]}...")
+
+
+if __name__ == "__main__":
+    main()

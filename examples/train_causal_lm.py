@@ -1,0 +1,53 @@
+"""Train the flagship CausalLM with a reference-style JSON config.
+
+    python examples/train_causal_lm.py --model tiny --steps 20
+    python examples/train_causal_lm.py --config my_ds_config.json
+"""
+import argparse
+import json
+
+import jax
+import numpy as np
+
+import deepspeedsyclsupport_tpu as dstpu
+from deepspeedsyclsupport_tpu.models import build_model
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="tiny",
+                   help="models zoo preset (tiny/small/llama2-7b/...)")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--seq_len", type=int, default=128)
+    p.add_argument("--config", default=None,
+                   help="DeepSpeed-style JSON config path (overrides the "
+                        "built-in demo config)")
+    args = p.parse_args()
+
+    config = json.load(open(args.config)) if args.config else {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 3e-4}},
+        "zero_optimization": {"stage": 2},
+        "bf16": {"enabled": True},
+        "activation_checkpointing": {"partition_activations": True},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 5,
+    }
+    model = build_model(args.model)
+    engine, _, _, _ = dstpu.initialize(model=model, config=config)
+
+    rng = np.random.RandomState(0)
+    for step in range(args.steps):
+        ids = rng.randint(1, model.config.vocab_size,
+                          size=(engine.train_batch_size(), args.seq_len))
+        metrics = engine.train_batch({"input_ids": ids.astype(np.int32)})
+        if step % 5 == 0 or step == args.steps - 1:
+            loss = float(np.asarray(jax.device_get(metrics["loss"])))
+            print(f"step {step:4d}  loss {loss:.4f}")
+    engine.save_checkpoint("./ckpt", tag=f"step{args.steps}")
+    print("checkpoint saved to ./ckpt")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""Train from a Megatron-format indexed corpus with curriculum sampling.
+
+Builds a tiny synthetic .bin/.idx corpus if none is given:
+
+    python examples/train_from_indexed_corpus.py --steps 10
+    python examples/train_from_indexed_corpus.py --data /corpora/pile_text_document
+"""
+import argparse
+
+import jax
+import numpy as np
+
+import deepspeedsyclsupport_tpu as dstpu
+from deepspeedsyclsupport_tpu.models import build_model
+from deepspeedsyclsupport_tpu.runtime.data_pipeline.data_sampling import (
+    DataAnalyzer, DSTpuDataSampler, MMapIndexedDataset,
+    MMapIndexedDatasetBuilder, data_file_path, index_file_path)
+from deepspeedsyclsupport_tpu.runtime.data_pipeline.data_sampling.data_sampler import (  # noqa: E501
+    IndexedTokenBatches)
+from deepspeedsyclsupport_tpu.runtime.dataloader import DSTpuDataLoader
+
+
+def synth_corpus(prefix: str, n: int = 256, vocab: int = 512) -> str:
+    rng = np.random.RandomState(0)
+    b = MMapIndexedDatasetBuilder(data_file_path(prefix), dtype=np.int32)
+    for _ in range(n):
+        b.add_item(rng.randint(1, vocab, size=rng.randint(8, 65)))
+    b.finalize(index_file_path(prefix))
+    return prefix
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--data", default=None,
+                   help=".bin/.idx prefix (synthesized when absent)")
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--seq_len", type=int, default=64)
+    args = p.parse_args()
+
+    prefix = args.data or synth_corpus("/tmp/dstpu_example_corpus")
+    ds = MMapIndexedDataset(prefix)
+    index = DataAnalyzer().run(ds)  # seqlen difficulty, free from the index
+
+    model = build_model("tiny")
+    engine, _, _, _ = dstpu.initialize(model=model, config={
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 1000,
+    })
+    sampler = DSTpuDataSampler(
+        index,
+        curriculum={"min_difficulty": 16, "max_difficulty": args.seq_len,
+                    "schedule_type": "fixed_linear",
+                    "schedule_config": {"total_curriculum_step": args.steps,
+                                        "difficulty_step": 8}},
+        micro_batch_size=engine.train_batch_size(), data_parallel_rank=0,
+        data_parallel_size=1, total_steps=args.steps, seed=1)
+    loader = DSTpuDataLoader(IndexedTokenBatches(ds, sampler, args.seq_len),
+                             engine.topology)
+    for step, batch in enumerate(loader):
+        m = engine.train_batch(batch)
+        loss = float(np.asarray(jax.device_get(m["loss"])))
+        print(f"step {step:3d}  difficulty<= "
+              f"{sampler.current_difficulty:3d}  loss {loss:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -205,6 +205,41 @@ class TestPagedLowering:
         lower_tpu(f, q, kc, kc, at, p0, ql)
 
 
+# -------------------------------------------------- long-context composites
+class TestLongContextLowering:
+    """The long-context parallel attention paths (ring CP over ppermute,
+    Ulysses all-to-all + flash) must cross-lower for TPU at production
+    long-sequence shapes — these are the reference's headline-perf paths
+    (Ulysses 54% MFU, ``blogs/deepspeed-ulysses/README.md:82``)."""
+
+    def test_ring_attention_8k(self, mesh8):
+        import deepspeedsyclsupport_tpu as ds
+        from deepspeedsyclsupport_tpu.comm.topology import (
+            reset_world_topology)
+        from deepspeedsyclsupport_tpu.parallel.ring_attention import (
+            ring_attention)
+
+        reset_world_topology()
+        topo = ds.build_topology(dp=2, sp=4)
+        q = sds((2, 8192, 16, 128))
+        lower_tpu(lambda q, k, v: ring_attention(q, k, v, causal=True,
+                                                 topology=topo), q, q, q)
+
+    def test_ulysses_gqa_8k(self, mesh8):
+        import deepspeedsyclsupport_tpu as ds
+        from deepspeedsyclsupport_tpu.comm.topology import (
+            reset_world_topology)
+        from deepspeedsyclsupport_tpu.parallel.ulysses import (
+            ulysses_attention)
+
+        reset_world_topology()
+        ds.build_topology(dp=1, sp=4, tp=2)
+        q = sds((1, 8192, 16, 128))
+        kv = sds((1, 8192, 8, 128))
+        lower_tpu(lambda q, k, v: ulysses_attention(q, k, v, causal=True),
+                  q, kv, kv)
+
+
 # ------------------------------------------------------ quantized collectives
 class TestQuantizedCollectiveLowering:
     """Cross-lower the explicit-collective (shard_map) comm ops for TPU over
