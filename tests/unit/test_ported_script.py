@@ -1,0 +1,78 @@
+"""A reference-style user training script, ported wholesale.
+
+The strongest migration claim is executable: this test IS the reference's
+canonical training-loop shape (initialize → forward/backward/step with
+gradient accumulation → LR schedule → save/load → resume → eval), with only
+the import changed — every API it touches keeps the reference name and
+contract (``deepspeed/__init__.py:64`` initialize tuple,
+``runtime/engine.py:1781,1922,2120`` forward/backward/step,
+``is_gradient_accumulation_boundary``, ``save_checkpoint:3050`` /
+``load_checkpoint:2688``, ``client_state``, lr_scheduler stepping)."""
+import numpy as np
+import pytest
+
+import deepspeedsyclsupport_tpu as deepspeed  # the one-line port
+
+from .simple_model import SimpleModel, random_dataset
+
+
+CONFIG = {
+    "train_batch_size": 16,
+    "train_micro_batch_size_per_gpu": 1,
+    "gradient_accumulation_steps": 2,
+    "optimizer": {"type": "AdamW", "params": {"lr": 1e-2,
+                                              "weight_decay": 0.01}},
+    "scheduler": {"type": "WarmupLR",
+                  "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 1e-2,
+                             "warmup_num_steps": 4}},
+    "gradient_clipping": 1.0,
+    "zero_optimization": {"stage": 2},
+    "steps_per_print": 1000,
+}
+
+
+def test_reference_training_loop_ports_verbatim(tmp_path):
+    model = SimpleModel(hidden_dim=32)
+    model_engine, optimizer, _, lr_scheduler = deepspeed.initialize(
+        model=model, config=CONFIG)
+    assert optimizer is not None and lr_scheduler is not None
+
+    data = random_dataset(8, hidden_dim=32, n_batches=4, seed=0)
+    # the reference's eager loop: micro-batches + accumulation boundary
+    losses = []
+    for epoch in range(2):
+        for batch in data:
+            loss = model_engine.forward(batch)
+            model_engine.backward(loss)
+            if model_engine.is_gradient_accumulation_boundary():
+                model_engine.step()
+            losses.append(float(np.asarray(loss)))
+    assert np.isfinite(losses).all()
+    assert model_engine.global_steps > 0
+
+    # reference checkpoint protocol: tag + client_state round-trip
+    model_engine.save_checkpoint(str(tmp_path), tag="ep2",
+                                 client_state={"epoch": 2})
+    path, client = model_engine.load_checkpoint(str(tmp_path), tag="ep2")
+    assert path is not None and client["epoch"] == 2
+
+    # resume in a FRESH engine: step counter and lr schedule continue
+    engine2, _, _, _ = deepspeed.initialize(
+        model=SimpleModel(hidden_dim=32), config=CONFIG)
+    engine2.load_checkpoint(str(tmp_path))
+    assert engine2.global_steps == model_engine.global_steps
+    lr_resumed = engine2.get_lr()
+    assert lr_resumed == pytest.approx(model_engine.get_lr(), rel=1e-6)
+
+    # fused path trains FROM the resumed state and improves; train_batch
+    # takes the GLOBAL batch (leading dim = train_batch_size = 16)
+    full = random_dataset(16, hidden_dim=32, n_batches=2, seed=9)
+    fused_losses = []
+    for _ in range(6):
+        m = engine2.train_batch(full[0])
+        fused_losses.append(float(np.asarray(m["loss"])))
+    assert fused_losses[-1] < fused_losses[0]
+
+    # eval path (reference eval_batch contract: returns the loss)
+    ev = engine2.eval_batch(full[1])
+    assert np.isfinite(float(np.asarray(ev)))
