@@ -1,0 +1,61 @@
+"""Parallel shell across the hostfile — the ``ds_ssh`` utility
+(reference ``bin/ds_ssh``: pdsh a command to every host in the hostfile).
+
+    dstpu-ssh -f hostfile -- uptime
+    dstpu-ssh -f hostfile --launcher ssh -- 'pkill -f train.py'
+
+Uses pdsh when present (the reference's only mode); falls back to plain
+ssh fan-out so the tool works on hosts without pdsh installed.
+"""
+import argparse
+import shutil
+import subprocess
+import sys
+from typing import List, Optional
+
+from .runner import parse_hostfile
+
+DEFAULT_HOSTFILE = "/job/hostfile"  # reference default
+
+
+def build_commands(hosts: List[str], command: str,
+                   launcher: str) -> List[List[str]]:
+    if launcher == "pdsh":
+        return [["pdsh", "-w", ",".join(hosts), command]]
+    return [["ssh", h, command] for h in hosts]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="dstpu-ssh",
+        description="run a command on every hostfile host "
+                    "(reference bin/ds_ssh)")
+    p.add_argument("-f", "--hostfile", default=DEFAULT_HOSTFILE)
+    p.add_argument("--launcher", choices=("auto", "pdsh", "ssh"),
+                   default="auto")
+    p.add_argument("--dry_run", action="store_true")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="command to run (prefix with -- to stop parsing)")
+    args = p.parse_args(argv)
+    cmd_tokens = [t for t in args.command if t != "--"]
+    if not cmd_tokens:
+        p.error("no command given")
+    command = " ".join(cmd_tokens)
+    hosts = [h for h, _ in parse_hostfile(args.hostfile)]
+    launcher = args.launcher
+    if launcher == "auto":
+        launcher = "pdsh" if shutil.which("pdsh") else "ssh"
+    cmds = build_commands(hosts, command, launcher)
+    if args.dry_run:
+        for c in cmds:
+            print(" ".join(c))
+        return 0
+    rc = 0
+    procs = [subprocess.Popen(c) for c in cmds]
+    for pr in procs:
+        rc = pr.wait() or rc
+    return rc
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

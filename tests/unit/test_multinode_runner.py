@@ -156,6 +156,33 @@ class TestElasticWiring:
         assert captured["ds_config"] == {"elasticity": {"enabled": False}}
 
 
+class TestDsSsh:
+    def test_fanout_commands(self, tmp_path, capsys):
+        from deepspeedsyclsupport_tpu.launcher.ds_ssh import main
+
+        hf = tmp_path / "hostfile"
+        hf.write_text("w1 slots=4\nw2 slots=4\n")
+        rc = main(["-f", str(hf), "--launcher", "ssh", "--dry_run", "--",
+                   "uptime", "-p"])
+        assert rc == 0
+        lines = capsys.readouterr().out.strip().splitlines()
+        assert lines == ["ssh w1 uptime -p", "ssh w2 uptime -p"]
+        rc = main(["-f", str(hf), "--launcher", "pdsh", "--dry_run", "--",
+                   "hostname"])
+        out = capsys.readouterr().out.strip()
+        assert out == "pdsh -w w1,w2 hostname"
+
+    def test_requires_command(self, tmp_path):
+        import pytest as _p
+
+        from deepspeedsyclsupport_tpu.launcher.ds_ssh import main
+
+        hf = tmp_path / "hostfile"
+        hf.write_text("w1\n")
+        with _p.raises(SystemExit):
+            main(["-f", str(hf)])
+
+
 class TestConsoleScripts:
     """The [project.scripts] contract (reference installs bin/deepspeed and
     bin/ds_report): entry points must resolve and run without installation."""
