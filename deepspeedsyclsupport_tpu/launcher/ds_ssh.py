@@ -8,6 +8,7 @@ Uses pdsh when present (the reference's only mode); falls back to plain
 ssh fan-out so the tool works on hosts without pdsh installed.
 """
 import argparse
+import shlex
 import shutil
 import subprocess
 import sys
@@ -37,18 +38,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="command to run (prefix with -- to stop parsing)")
     args = p.parse_args(argv)
-    cmd_tokens = [t for t in args.command if t != "--"]
+    cmd_tokens = list(args.command)
+    if cmd_tokens[:1] == ["--"]:  # strip only the LEADING separator —
+        del cmd_tokens[0]         # later '--' tokens belong to the command
     if not cmd_tokens:
         p.error("no command given")
-    command = " ".join(cmd_tokens)
-    hosts = [h for h, _ in parse_hostfile(args.hostfile)]
+    # quote per token: the remote shell must see the caller's tokens, not
+    # re-split spaces or expand metacharacters
+    command = " ".join(shlex.quote(t) for t in cmd_tokens)
+    try:
+        hosts = [h for h, _ in parse_hostfile(args.hostfile)]
+    except (OSError, ValueError) as e:
+        p.error(f"hostfile {args.hostfile}: {e}")
     launcher = args.launcher
     if launcher == "auto":
         launcher = "pdsh" if shutil.which("pdsh") else "ssh"
     cmds = build_commands(hosts, command, launcher)
     if args.dry_run:
         for c in cmds:
-            print(" ".join(c))
+            print(" ".join(shlex.quote(t) for t in c))
         return 0
     rc = 0
     procs = [subprocess.Popen(c) for c in cmds]

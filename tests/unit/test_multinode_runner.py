@@ -166,11 +166,21 @@ class TestDsSsh:
                    "uptime", "-p"])
         assert rc == 0
         lines = capsys.readouterr().out.strip().splitlines()
-        assert lines == ["ssh w1 uptime -p", "ssh w2 uptime -p"]
+        assert lines == ["ssh w1 'uptime -p'", "ssh w2 'uptime -p'"]
         rc = main(["-f", str(hf), "--launcher", "pdsh", "--dry_run", "--",
                    "hostname"])
         out = capsys.readouterr().out.strip()
         assert out == "pdsh -w w1,w2 hostname"
+        # only the LEADING '--' is stripped; command tokens with spaces
+        # survive quoting intact (pathspec separators, pkill patterns)
+        import shlex
+
+        main(["-f", str(hf), "--launcher", "pdsh", "--dry_run", "--",
+              "git", "log", "--", "a path/x.py"])
+        out = capsys.readouterr().out.strip()
+        inner = " ".join(shlex.quote(t)
+                         for t in ["git", "log", "--", "a path/x.py"])
+        assert shlex.split(out)[-1] == inner
 
     def test_requires_command(self, tmp_path):
         import pytest as _p
@@ -197,7 +207,7 @@ class TestConsoleScripts:
 
     def test_declared(self):
         eps = self._entry_points()
-        assert "dstpu" in eps and "dstpu-report" in eps
+        assert {"dstpu", "dstpu-report", "dstpu-ssh"} <= set(eps)
 
     def test_resolve_and_smoke(self, capsys):
         import importlib
