@@ -2,7 +2,10 @@
 (reference ``bin/ds_ssh``: pdsh a command to every host in the hostfile).
 
     dstpu-ssh -f hostfile -- uptime
-    dstpu-ssh -f hostfile --launcher ssh -- 'pkill -f train.py'
+    dstpu-ssh -f hostfile -- pkill -f train.py
+
+(Pass the command as separate tokens, not one quoted string — each token
+is quoted for the remote shell verbatim.)
 
 Uses pdsh when present (the reference's only mode); falls back to plain
 ssh fan-out so the tool works on hosts without pdsh installed.

@@ -41,6 +41,55 @@ def _common(params: Dict[str, Any]):
     return lr, (float(betas[0]), float(betas[1])), eps, wd
 
 
+def _path_segments(path):
+    """Pytree key path → name segments, covering all four key types
+    (DictKey.key, SequenceKey.idx, GetAttrKey.name, FlattenedIndexKey.key)."""
+    segs = []
+    for k in path:
+        for attr in ("key", "name", "idx"):
+            if hasattr(k, attr):
+                segs.append(str(getattr(k, attr)))
+                break
+        else:
+            segs.append(str(k))
+    return segs
+
+
+def _decay_mask(patterns):
+    """Weight-decay mask from path patterns (the torch param-group idiom —
+    `{"params": no_decay, "weight_decay": 0.0}` for biases/norms — as a
+    config knob: ``optimizer.params.no_decay_patterns``). A pattern matches
+    a WHOLE path segment (glob syntax allowed: "b", "bias", "*_norm"), or —
+    when it contains "/" — a substring of the "/"-joined path. Bare
+    substring matching is deliberately NOT used: a short pattern like "b"
+    must not silently un-decay "embed" or "blocks". Returns a callable
+    params-tree → bool tree (True = decay) for optax's ``mask=``, or None
+    when unset."""
+    if not patterns:
+        return None
+    import fnmatch
+
+    pats = [str(x) for x in patterns]
+
+    def excluded(segs):
+        joined = "/".join(segs)
+        for pat in pats:
+            if "/" in pat:
+                if pat in joined:
+                    return True
+            elif any(fnmatch.fnmatch(seg, pat) for seg in segs):
+                return True
+        return False
+
+    def mask(tree):
+        import jax
+
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: not excluded(_path_segments(path)), tree)
+
+    return mask
+
+
 def build_optimizer(opt_type: str, params: Dict[str, Any],
                     lr_schedule: Optional[Callable] = None) -> optax.GradientTransformation:
     """Map config ``optimizer.type``+``params`` to an optax transform.
@@ -55,6 +104,16 @@ def build_optimizer(opt_type: str, params: Dict[str, Any],
     t = opt_type.lower().replace("_", "")
     lr, betas, eps, wd = _common(params)
     schedule = lr_schedule if lr_schedule is not None else lr
+    mask = _decay_mask(params.get("no_decay_patterns"))
+    wd_kw = {} if mask is None else {"mask": mask}
+    if mask is not None and t in (ONEBIT_ADAM, ZERO_ONE_ADAM, ONEBIT_LAMB):
+        # the 1-bit family applies decay inside its fused update; silently
+        # decaying excluded params would diverge from the same config under
+        # AdamW — refuse loudly instead
+        raise ValueError(
+            f"no_decay_patterns is not supported with {opt_type!r} "
+            f"(the 1-bit optimizers decay every leaf); drop the patterns "
+            f"or use AdamW/Lamb/Lion")
 
     if t == ONEBIT_ADAM:
         from .onebit import onebit_adam
@@ -91,24 +150,28 @@ def build_optimizer(opt_type: str, params: Dict[str, Any],
             factor_threshold=float(params.get("factor_threshold", 0.1)))
 
     if t == ADAMW_OPTIMIZER:
-        tx = optax.inject_hyperparams(optax.adamw)(
-            learning_rate=schedule, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
+        tx = optax.inject_hyperparams(optax.adamw, static_args=("mask",))(
+            learning_rate=schedule, b1=betas[0], b2=betas[1], eps=eps,
+            weight_decay=wd, **wd_kw)
     elif t in (ADAM_OPTIMIZER, FUSED_ADAM, CPU_ADAM):
         # reference FusedAdam/CPUAdam default adam_w_mode=True → AdamW;
         # adam_w_mode=False is classic Adam (no decoupled decay)
         if params.get("adam_w_mode", params.get("adamw_mode", True)):
-            tx = optax.inject_hyperparams(optax.adamw)(
+            tx = optax.inject_hyperparams(optax.adamw,
+                                          static_args=("mask",))(
                 learning_rate=schedule, b1=betas[0], b2=betas[1], eps=eps,
-                weight_decay=wd)
+                weight_decay=wd, **wd_kw)
         else:
             tx = optax.inject_hyperparams(optax.adam)(
                 learning_rate=schedule, b1=betas[0], b2=betas[1], eps=eps)
     elif t in (LAMB_OPTIMIZER, FUSED_LAMB):
-        tx = optax.inject_hyperparams(optax.lamb)(
-            learning_rate=schedule, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
+        tx = optax.inject_hyperparams(optax.lamb, static_args=("mask",))(
+            learning_rate=schedule, b1=betas[0], b2=betas[1], eps=eps,
+            weight_decay=wd, **wd_kw)
     elif t in (LION_OPTIMIZER, FUSED_LION):
-        tx = optax.inject_hyperparams(optax.lion)(
-            learning_rate=schedule, b1=betas[0], b2=betas[1], weight_decay=wd)
+        tx = optax.inject_hyperparams(optax.lion, static_args=("mask",))(
+            learning_rate=schedule, b1=betas[0], b2=betas[1],
+            weight_decay=wd, **wd_kw)
     elif t == SGD_OPTIMIZER:
         tx = optax.inject_hyperparams(optax.sgd)(
             learning_rate=schedule, momentum=float(params.get("momentum", 0.0)))

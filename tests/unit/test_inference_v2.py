@@ -172,6 +172,34 @@ class TestEngineV2:
         assert eng.allocator.free_blocks > used  # blocks returned
         assert eng.query(11) is None
 
+    def test_expert_and_tensor_parallel_serving_parity(self):
+        """MoE serving over an expert-parallel (and TP-composed) topology —
+        the reference's DeepSpeedMoEInference EP story: declarative expert
+        shardings partition the grouped GEMMs, logits bit-match the
+        replicated engine."""
+        import deepspeedsyclsupport_tpu as ds
+        from deepspeedsyclsupport_tpu.comm.topology import (
+            reset_world_topology)
+
+        model = build_model("tiny-moe", dtype="float32")
+        params = model.init_params()
+        prompt = [1, 5, 9, 200, 3]
+
+        def serve(**axes):
+            reset_world_topology()
+            topo = ds.build_topology(dp=-1, **axes)
+            eng = InferenceEngineV2(model, params, dtype=jnp.float32,
+                                    block_size=8, max_context=64,
+                                    max_tokens_per_batch=16, topology=topo)
+            out = np.asarray(eng.put([1], [prompt])[1])
+            eng.flush([1])
+            return out
+
+        base = serve()
+        np.testing.assert_allclose(serve(ep=2), base, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(serve(ep=2, tp=2), base, rtol=1e-5,
+                                   atol=1e-5)
+
     def test_eviction_policy_selects_victim(self, tiny):
         """generate() under KV pressure sheds the victim the configured
         policy names (VERDICT r3 weak #6: longest-evict was the only

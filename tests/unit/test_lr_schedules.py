@@ -59,3 +59,74 @@ def test_build_schedule_errors():
         lrs.build_schedule("Bogus", {}, 1e-3)
     s = lrs.build_schedule(None, {}, 5e-4)
     np.testing.assert_allclose(float(s(123)), 5e-4)
+
+
+class TestNoDecayPatterns:
+    """optimizer.params.no_decay_patterns — the torch param-group idiom
+    ({"params": no_decay, "weight_decay": 0.0} for biases/norms) as a
+    config knob over optax's decay mask."""
+
+    @pytest.mark.parametrize("opt", ["AdamW", "Lamb", "Lion", "Adam"])
+    def test_excluded_leaves_do_not_decay(self, opt):
+        import jax
+        import jax.numpy as jnp
+
+        from deepspeedsyclsupport_tpu.runtime.optimizers import build_optimizer
+
+        tx = build_optimizer(opt, {"lr": 0.1, "weight_decay": 0.5,
+                                   "no_decay_patterns": ["b", "norm"]})
+        params = {"layer": {"w": jnp.ones((2, 2)), "b": jnp.ones((2,)),
+                            "norm": {"scale": jnp.ones((2,))}}}
+        st = tx.init(params)
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        up, _ = tx.update(zeros, st, params)
+        # zero grads → the only update source is decoupled weight decay
+        assert float(jnp.abs(up["layer"]["w"]).max()) > 0
+        assert float(jnp.abs(up["layer"]["b"]).max()) == 0
+        assert float(jnp.abs(up["layer"]["norm"]["scale"]).max()) == 0
+
+    def test_engine_trains_with_mask(self):
+        import numpy as np
+
+        import deepspeedsyclsupport_tpu as dstpu
+
+        from .simple_model import SimpleModel, random_dataset, simple_config
+
+        model = SimpleModel(hidden_dim=16)
+        cfg = simple_config(
+            train_batch_size=8, train_micro_batch_size_per_gpu=1,
+            optimizer={"type": "AdamW",
+                       "params": {"lr": 1e-2, "weight_decay": 0.1,
+                                  "no_decay_patterns": ["b"]}})
+        engine, _, _, _ = dstpu.initialize(model=model, config=cfg)
+        data = random_dataset(8, hidden_dim=16, n_batches=1, seed=0)[0]
+        losses = [float(np.asarray(engine.train_batch(data)["loss"]))
+                  for _ in range(4)]
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+    def test_segment_matching_not_substring(self):
+        import jax.numpy as jnp
+
+        from deepspeedsyclsupport_tpu.runtime.optimizers import _decay_mask
+
+        mask = _decay_mask(["b"])
+        tree = {"embed": {"kernel": jnp.ones(2)},  # contains 'b' as SUBSTRING
+                "layer": {"b": jnp.ones(2)}}
+        m = mask(tree)
+        assert m["embed"]["kernel"] is True   # still decays
+        assert m["layer"]["b"] is False       # excluded (whole segment)
+        # glob over segments; '/'-patterns match the joined path
+        m2 = _decay_mask(["*_norm"])({"attn_norm": {"scale": jnp.ones(2)},
+                                      "w": jnp.ones(2)})
+        assert m2["attn_norm"]["scale"] is False and m2["w"] is True
+        m3 = _decay_mask(["layer/b"])(tree)
+        assert m3["layer"]["b"] is False and m3["embed"]["kernel"] is True
+
+    def test_onebit_family_rejects_patterns(self):
+        import pytest as _p
+
+        from deepspeedsyclsupport_tpu.runtime.optimizers import build_optimizer
+
+        with _p.raises(ValueError, match="no_decay_patterns"):
+            build_optimizer("OneBitAdam", {"lr": 1e-3, "weight_decay": 0.1,
+                                           "no_decay_patterns": ["b"]})
