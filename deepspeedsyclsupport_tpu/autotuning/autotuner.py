@@ -240,10 +240,28 @@ class Autotuner:
             activation_bytes=act, remat=remat, num_layers=layers)
 
     # ------------------------------------------------------------------ search
-    def tune(self) -> TuneResult:
+    def tune(self, strategy: str = "grid",
+             num_trials: Optional[int] = None,
+             seed: int = 0) -> TuneResult:
+        """Search the space (reference tuner strategies,
+        ``autotuning/tuner/``):
+
+        * ``grid`` — measure every in-budget candidate (GridSearchTuner).
+        * ``random`` — measure ``num_trials`` uniformly sampled candidates
+          (RandomTuner).
+        * ``model_based`` — rank in-budget candidates by the memory model
+          (largest predicted footprint that still fits first — the
+          max-micro-batch-first philosophy of the reference's cost-model
+          tuner) and measure only the top ``num_trials``.
+        """
+        if strategy not in ("grid", "random", "model_based"):
+            raise ValueError(f"unknown strategy {strategy!r}")
+        if strategy != "grid" and not num_trials:
+            raise ValueError(f"{strategy} strategy needs num_trials=")
         keys = list(self.space)
         trials = []
-        best = (None, float("-inf"))
+        # enumerate + model-prune first (cheap, no compilation)
+        candidates = []
         for combo in itertools.product(*(self.space[k] for k in keys)):
             cfg = _deepcopy_config(self.base_config)
             label = dict(zip(keys, combo))
@@ -262,6 +280,24 @@ class Autotuner:
                             "budget %.2f GB)", label, pred / 1e9,
                             self.hbm_bytes / 1e9)
                 continue
+            candidates.append((label, cfg, pred))
+
+        if strategy == "random" and num_trials < len(candidates):
+            import random as _random
+
+            rng = _random.Random(seed)
+            candidates = rng.sample(candidates, num_trials)
+        elif strategy == "model_based" and num_trials < len(candidates):
+            skipped = sorted(candidates,
+                             key=lambda c: -c[2])[num_trials:]
+            candidates = sorted(candidates,
+                                key=lambda c: -c[2])[:num_trials]
+            for label, _cfg, pred in skipped:
+                trials.append({**label, "throughput": float("-inf"),
+                               "skipped": True, "predicted_bytes": pred})
+
+        best = (None, float("-inf"))
+        for label, cfg, pred in candidates:
             tput = (self._measure(cfg, label) if self.mode == "in_process"
                     else self._measure_subprocess(cfg, label))
             trials.append({**label, "throughput": tput,
@@ -271,7 +307,7 @@ class Autotuner:
         if best[0] is None:
             raise RuntimeError("no autotuning candidate succeeded")
         result = TuneResult(best[0], best[1], trials)
-        log_dist(f"autotune: best {best[1]:.1f} samples/s with "
+        log_dist(f"autotune[{strategy}]: best {best[1]:.1f} with "
                  f"{ {k: _get_nested(best[0], k) for k in keys} } "
                  f"({len(result.pruned)} candidates pruned by the memory "
                  f"model, {len(trials)} trials)")

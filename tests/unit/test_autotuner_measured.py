@@ -96,3 +96,56 @@ class TestModeValidation:
             Autotuner(model, BASE, mode="warp")
         with pytest.raises(ValueError):
             Autotuner(model, BASE, kind="paint")
+
+
+class TestStrategies:
+    """Reference tuner strategies (autotuning/tuner/): grid / random /
+    model-based candidate selection over the same measured core."""
+
+    def _tuner(self, **kw):
+        model = build_model("tiny")
+        base = dict(BASE)
+        return Autotuner(model, base, make_batch=None, mode="subprocess",
+                         model_name="tiny", seq_len=32, hbm_bytes=0,
+                         trial_timeout=420, trial_env=CHILD_ENV, steps=1,
+                         warmup=1, **kw)
+
+    def test_random_samples_budgeted_trials(self, monkeypatch):
+        t = self._tuner(space={"zero_optimization.stage": [0, 1, 2, 3]})
+        measured = []
+        monkeypatch.setattr(
+            t, "_measure_subprocess",
+            lambda cfg, label: measured.append(dict(label)) or 1.0)
+        res = t.tune(strategy="random", num_trials=2, seed=3)
+        assert len(measured) == 2
+        assert len([x for x in res.trials
+                    if not x.get("pruned") and not x.get("skipped")]) == 2
+        # deterministic under the seed
+        measured2 = []
+        t2 = self._tuner(space={"zero_optimization.stage": [0, 1, 2, 3]})
+        monkeypatch.setattr(
+            t2, "_measure_subprocess",
+            lambda cfg, label: measured2.append(dict(label)) or 1.0)
+        t2.tune(strategy="random", num_trials=2, seed=3)
+        assert measured == measured2
+
+    def test_model_based_prefers_largest_fitting_footprint(self, monkeypatch):
+        t = self._tuner(
+            space={"train_micro_batch_size_per_gpu": [1, 2, 4]})
+        measured = []
+        monkeypatch.setattr(
+            t, "_measure_subprocess",
+            lambda cfg, label: measured.append(dict(label)) or 1.0)
+        res = t.tune(strategy="model_based", num_trials=1)
+        # biggest predicted footprint (mbs=4) measured; others marked skipped
+        assert measured == [{"train_micro_batch_size_per_gpu": 4}]
+        skipped = [x for x in res.trials if x.get("skipped")]
+        assert {x["train_micro_batch_size_per_gpu"]
+                for x in skipped} == {1, 2}
+
+    def test_strategy_validation(self):
+        t = self._tuner()
+        with pytest.raises(ValueError, match="strategy"):
+            t.tune(strategy="bayesian")
+        with pytest.raises(ValueError, match="num_trials"):
+            t.tune(strategy="random")
