@@ -54,19 +54,21 @@ class TuneResult:
         }
         with open(path, "w") as f:
             json.dump(record, f, indent=2, default=str)
-        ranked = sorted((t for t in self.trials if not t.get("pruned")),
+        ranked = sorted((t for t in self.trials
+                         if not t.get("pruned") and not t.get("skipped")),
                         key=lambda t: -t["throughput"])
+        drop_keys = ("throughput", "predicted_bytes", "pruned", "skipped",
+                     "error")
         lines = [f"{'rank':<6}{'throughput':>14}  config",
                  "-" * 72]
         for i, t in enumerate(ranked):
-            label = {k: v for k, v in t.items()
-                     if k not in ("throughput", "predicted_bytes", "pruned",
-                                  "error")}
+            label = {k: v for k, v in t.items() if k not in drop_keys}
             lines.append(f"{i:<6}{t['throughput']:>14.1f}  {label}")
-        for t in self.pruned:
-            label = {k: v for k, v in t.items()
-                     if k not in ("throughput", "predicted_bytes", "pruned")}
-            lines.append(f"{'—':<6}{'pruned':>14}  {label}")
+        for t in self.trials:
+            if t.get("pruned") or t.get("skipped"):
+                label = {k: v for k, v in t.items() if k not in drop_keys}
+                tag = "pruned" if t.get("pruned") else "skipped"
+                lines.append(f"{'—':<6}{tag:>14}  {label}")
         txt = path.rsplit(".", 1)[0] + "_summary.txt"
         with open(txt, "w") as f:
             f.write("\n".join(lines) + "\n")
@@ -288,10 +290,15 @@ class Autotuner:
             rng = _random.Random(seed)
             candidates = rng.sample(candidates, num_trials)
         elif strategy == "model_based" and num_trials < len(candidates):
-            skipped = sorted(candidates,
-                             key=lambda c: -c[2])[num_trials:]
-            candidates = sorted(candidates,
-                                key=lambda c: -c[2])[:num_trials]
+            if not any(pred for _l, _c, pred in candidates):
+                # no memory model available (serve kind / no init_params):
+                # a silent arbitrary pick would masquerade as model-ranked
+                raise ValueError(
+                    "model_based strategy has no memory-model predictions "
+                    "to rank by here (kind='serve' or un-countable model); "
+                    "use strategy='random' or 'grid'")
+            ranked = sorted(candidates, key=lambda c: -c[2])
+            candidates, skipped = ranked[:num_trials], ranked[num_trials:]
             for label, _cfg, pred in skipped:
                 trials.append({**label, "throughput": float("-inf"),
                                "skipped": True, "predicted_bytes": pred})
@@ -307,10 +314,12 @@ class Autotuner:
         if best[0] is None:
             raise RuntimeError("no autotuning candidate succeeded")
         result = TuneResult(best[0], best[1], trials)
+        n_measured = len(candidates)
+        n_skipped = sum(1 for t in trials if t.get("skipped"))
         log_dist(f"autotune[{strategy}]: best {best[1]:.1f} with "
                  f"{ {k: _get_nested(best[0], k) for k in keys} } "
-                 f"({len(result.pruned)} candidates pruned by the memory "
-                 f"model, {len(trials)} trials)")
+                 f"({n_measured} measured, {len(result.pruned)} pruned by "
+                 f"the memory model, {n_skipped} skipped)")
         return result
 
     # ------------------------------------------------------------------ trial
