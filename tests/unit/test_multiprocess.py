@@ -114,6 +114,19 @@ for a, b in zip(jax.tree_util.tree_leaves(eng_off.params),
                                rtol=2e-4, atol=2e-5)
 print(f"[rank {rank}] CHECK multihost_offload", flush=True)
 
+# --- multi-controller straggler columns: one digest-checked allgather ---
+from deepspeedsyclsupport_tpu.comm.comms_logging import comms_logger
+comms_logger.reset()  # engine runs above may have recorded wall-times
+comms_logger.record_wall("train_batch", 0.5 + 0.25 * rank)  # rank-skewed
+table = comms_logger.log_summary(show_straggler=True)  # ALL ranks: collective
+assert "wall-clock (per host)" in table and "train_batch" in table
+import re as _re
+row = next(l for l in table.splitlines() if l.startswith("train_batch"))
+nums = [float(x) for x in _re.findall(r"\d+\.\d+", row)]
+assert nums[-2:] == [0.5, 0.75], row    # min/max across the two hosts
+comms_logger.reset()
+print(f"[rank {rank}] CHECK straggler_summary", flush=True)
+
 # offload checkpoint: global-array reassembly of per-host shards
 ck2 = os.path.join(os.environ["CKPT_DIR"], "offload")
 eng_off.save_checkpoint(ck2, tag="s2")
@@ -171,5 +184,5 @@ def test_two_process_distributed(tmp_path):
         assert "ALL OK" in out, f"rank {rank} incomplete:\n{out[-4000:]}"
         for check in ("rendezvous", "train_step", "tag_validation",
                       "reshard_load", "multihost_offload",
-                      "multihost_offload_ckpt"):
+                      "straggler_summary", "multihost_offload_ckpt"):
             assert f"CHECK {check}" in out, (check, out[-2000:])
