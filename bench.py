@@ -488,6 +488,12 @@ def _drive_serving(eng, prompts, n_clients, reqs_per_client, gen_len, mode,
                 live[uid] = c
             if admit_u:
                 eng.put(admit_u, admit_t, drain=True)  # decode stalls
+                # logits are device-resident and put() is async-dispatch:
+                # force completion BEFORE stamping TTFT
+                for uid in admit_u:
+                    lg = eng.query(uid)
+                    if lg is not None:
+                        np.asarray(lg)
                 now = time.perf_counter()
                 for uid in admit_u:
                     ttfts.append(now - submitted[uid])
@@ -505,6 +511,9 @@ def _drive_serving(eng, prompts, n_clients, reqs_per_client, gen_len, mode,
             if lg is None:
                 continue
             awaiting.discard(uid)
+            # force the device value BEFORE stamping: the forward is async
+            lg = np.asarray(lg)
+            now = time.perf_counter()
             if uid not in ttft_done:      # prompt just drained (splitfuse)
                 ttfts.append(now - submitted[uid])
                 ttft_done.add(uid)

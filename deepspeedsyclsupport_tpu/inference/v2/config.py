@@ -19,7 +19,10 @@ class RaggedInferenceConfig:
     max_context: int = 2048         # per-sequence KV budget (max_context)
     num_blocks: Optional[int] = None  # total KV pool; default sized for half the
     # worst case (continuous batching overcommits, like the reference's
-    # memory_config-driven cache sizing)
+    # memory_config-driven cache sizing). HBM sizing note: each LIVE
+    # sequence also pins one device-resident logits row (V floats at the
+    # serving dtype) until flush — budget ~V*4B*max_sequences alongside
+    # the KV pool
     dtype: Any = jnp.bfloat16
     seed: int = 0
     quantize_weights: bool = False   # ZeRO-Inference int8/int4 layer weights

@@ -11,7 +11,7 @@ ONE jitted ragged forward (QKV+RoPE+paged-append, blocked attention, MLP,
 logits gather) → last-token logits land back in each sequence descriptor.
 """
 import dataclasses
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -44,7 +44,7 @@ class AdmissionResult:
         return not self.reasons
 
 
-class PutResult(Dict[int, np.ndarray]):
+class PutResult(Dict[int, jax.Array]):
     """:meth:`InferenceEngineV2.put`'s return: the {uid: last-token logits}
     mapping (drop-in for the plain dict earlier rounds returned) plus the
     admission outcome, so schedulers see partial rejection without an
@@ -327,7 +327,7 @@ class InferenceEngineV2:
         return max(range(len(uids)),
                    key=lambda i: self.seqs[uids[i]].n_cached)
 
-    def _run(self, chunks) -> np.ndarray:
+    def _run(self, chunks) -> jax.Array:
         cfg = self.config
         if all(n == 1 and d.n_cached > 0 for d, n in chunks):
             return self._run_decode(chunks)  # kernel fast path
@@ -347,9 +347,12 @@ class InferenceEngineV2:
             jnp.asarray(batch.token_seq), jnp.asarray(batch.token_pos),
             jnp.asarray(batch.block_tables), jnp.asarray(batch.last_tok_idx),
             *atom_args)
-        return np.asarray(logits[:len(chunks)])
+        # DEVICE-resident: per-slot rows are sliced on device and only
+        # fetched when a caller materializes them (query()/np.asarray) —
+        # generate()'s sampler consumes them without a host round trip
+        return logits[:len(chunks)]
 
-    def _run_decode(self, chunks) -> np.ndarray:
+    def _run_decode(self, chunks) -> jax.Array:
         """Pure-decode batches (serving's steady state) route through the
         Pallas paged-attention program (``ops/paged_attention``)."""
         from .model import build_decode_forward_fn
@@ -371,12 +374,17 @@ class InferenceEngineV2:
         logits, self.kv = self._decode_forward(
             self.params, self.kv, jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(tables), jnp.asarray(active))
-        return np.asarray(logits[:len(chunks)])
+        # DEVICE-resident: per-slot rows are sliced on device and only
+        # fetched when a caller materializes them (query()/np.asarray) —
+        # generate()'s sampler consumes them without a host round trip
+        return logits[:len(chunks)]
 
     # ------------------------------------------------------------ query/flush
-    def query(self, uid: int) -> Optional[np.ndarray]:
+    def query(self, uid: int) -> Optional[jax.Array]:
         """Last-token logits if the uid's input has drained (reference
-        ``query:153``)."""
+        ``query:153``). DEVICE-resident (a jax array): ``np.asarray`` it to
+        materialize on host; device consumers (samplers) use it without a
+        host round trip."""
         d = self.seqs.get(uid)
         return None if d is None else d.last_logits
 
@@ -425,8 +433,11 @@ class InferenceEngineV2:
             drained = [(u, lg) for u, lg in drained if lg is not None]
             if drained:
                 rng, sub = jax.random.split(rng)
+                # logits are device-resident: stack + sample stay on device;
+                # only the sampled token ids (one int per sequence) cross to
+                # the host — not 2×V floats per sequence per step
                 toks = np.asarray(self._sample_fn(
-                    jnp.asarray(np.stack([lg for _, lg in drained])), sub, sp))
+                    jnp.stack([lg for _, lg in drained]), sub, sp))
                 for (uid, _), tok in zip(drained, toks):
                     tok = int(tok)
                     results[uid - uid_base].append(tok)
