@@ -45,6 +45,15 @@ class RaggedInferenceConfig:
     # KV-pressure eviction victim: longest_context (truncation-biased,
     # default) | lru (least-recently-scheduled) | newest (LIFO backoff)
     eviction_policy: str = "longest_context"
+    # steady-state decode fusion: when every live sequence is decoding and
+    # nothing is waiting, run up to this many decode steps (forward +
+    # on-device sample + paged-KV append + position advance) inside ONE
+    # jitted while_loop, returning all sampled tokens in a single host
+    # transfer. 1 = one host-scheduled forward per token (the reference's
+    # per-iteration MII loop, ``engine_v2.py:107``); >1 amortizes host
+    # scheduling + dispatch across K tokens — the steady-state analog of
+    # the reference's ragged-kernel amortization
+    decode_steps_per_dispatch: int = 1
 
     def __post_init__(self):
         if not isinstance(self.prefill_attn, str) or not self.prefill_attn:
@@ -65,6 +74,9 @@ class RaggedInferenceConfig:
         if self.atom_q_size < 1:
             raise ValueError(f"atom_q_size must be >= 1, got "
                              f"{self.atom_q_size}")
+        if self.decode_steps_per_dispatch < 1:
+            raise ValueError(f"decode_steps_per_dispatch must be >= 1, got "
+                             f"{self.decode_steps_per_dispatch}")
         if self.quant_bits not in (4, 8):
             raise ValueError(f"quant_bits must be 4 or 8, got "
                              f"{self.quant_bits}")

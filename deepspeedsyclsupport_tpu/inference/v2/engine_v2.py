@@ -23,7 +23,7 @@ from .model import build_ragged_forward_fn
 from .ragged import BlockedAllocator, SequenceDescriptor, build_ragged_batch
 from .scheduler import schedule_chunks
 from ..params import place_inference_params
-from ..sampling import SamplingParams, sample_token
+from ..sampling import SamplingParams, sample_token_dyn
 from ...comm.topology import MeshTopology, build_topology
 from ...utils.logging import log_dist
 
@@ -86,8 +86,18 @@ class InferenceEngineV2:
         self._forward = build_ragged_forward_fn(model, cfg.block_size,
                                                 attn_impl=cfg.prefill_attn)
         self._decode_forward = None  # built lazily (kernel path)
+        # (K, sampling STRUCTURE) -> jitted K-step program; temperature/
+        # top_p/eos are traced operands so they never force a recompile.
+        # Bounded LRU: each entry is a full compiled model program
+        from collections import OrderedDict
+
+        self._decode_multi: "OrderedDict[Any, Any]" = OrderedDict()
+        self._decode_multi_cap = 16
+        self.host_dispatches = 0  # host-scheduled device dispatches (bench)
         self._rng = jax.random.PRNGKey(cfg.seed)
-        self._sample_fn = jax.jit(sample_token, static_argnums=(2,))
+        # only the sampling STRUCTURE is static; temperature/top_p are
+        # operands (sweeping them reuses one compiled sampler)
+        self._sample_fn = jax.jit(sample_token_dyn, static_argnums=(4,))
         # atoms feed only impls that declare needs_atoms — decide ONCE
         # whether that path runs so prefill forwards skip the host atom
         # build + five-array transfer when it cannot (registry metadata;
@@ -199,7 +209,27 @@ class InferenceEngineV2:
                 raise RuntimeError(
                     f"warmup could not admit its sequence — call warmup() "
                     f"on an idle engine ({dict(out.admission.reasons)})")
+        if cfg.decode_steps_per_dispatch > 1:
+            # compile the fused K-step steady-state program too, for
+            # generate()'s default greedy/no-eos config (non-default sampling
+            # STRUCTURES still compile on first use). Restart from a fresh
+            # 1-token sequence so context headroom never truncates the two
+            # dispatches below the full K the serving loop will use
+            k = cfg.decode_steps_per_dispatch
+            self.flush([uid])
+            self.put([uid], [[2]])
+            running = {uid: 2 * k + 1}
+            for _ in range(2):
+                if uid not in running:
+                    break
+                self._decode_multi_dispatch(running, SamplingParams(), None,
+                                            jax.random.PRNGKey(0))
+            if (k, SamplingParams().structure) not in self._decode_multi:
+                log_dist(f"warmup: fused decode program (K={k}) not "
+                         f"pre-compiled — KV pool too small to pre-fund it; "
+                         f"first steady-state generate() will compile")
         self.flush([uid])
+        self.host_dispatches = 0  # counter measures serving, not warmup
 
     # ------------------------------------------------------------- scheduling
     def can_schedule(self, uids: Sequence[int],
@@ -347,10 +377,26 @@ class InferenceEngineV2:
             jnp.asarray(batch.token_seq), jnp.asarray(batch.token_pos),
             jnp.asarray(batch.block_tables), jnp.asarray(batch.last_tok_idx),
             *atom_args)
+        self.host_dispatches += 1
         # DEVICE-resident: per-slot rows are sliced on device and only
         # fetched when a caller materializes them (query()/np.asarray) —
         # generate()'s sampler consumes them without a host round trip
         return logits[:len(chunks)]
+
+    def _slot_arrays(self, descs):
+        """Per-slot decode metadata padded to max_sequences — the ONE
+        assembly both the per-token and fused decode paths ship to device
+        (position, block table, live mask per slot)."""
+        cfg = self.config
+        s_max = cfg.max_sequences
+        positions = np.zeros((s_max,), np.int32)
+        tables = np.zeros((s_max, cfg.blocks_per_seq), np.int32)
+        active = np.zeros((s_max,), bool)
+        for slot, d in enumerate(descs):
+            positions[slot] = d.n_cached
+            tables[slot, :len(d.blocks)] = d.blocks
+            active[slot] = True
+        return positions, tables, active
 
     def _run_decode(self, chunks) -> jax.Array:
         """Pure-decode batches (serving's steady state) route through the
@@ -361,23 +407,108 @@ class InferenceEngineV2:
         if self._decode_forward is None:
             self._decode_forward = build_decode_forward_fn(
                 self.model, cfg.block_size, attn_impl=cfg.decode_attn)
-        s_max = cfg.max_sequences
-        tokens = np.zeros((s_max,), np.int32)
-        positions = np.zeros((s_max,), np.int32)
-        tables = np.zeros((s_max, cfg.blocks_per_seq), np.int32)
-        active = np.zeros((s_max,), bool)
+        positions, tables, active = self._slot_arrays(
+            [d for d, _n in chunks])
+        tokens = np.zeros((cfg.max_sequences,), np.int32)
         for slot, (d, _n) in enumerate(chunks):
             tokens[slot] = d.pending[0]
-            positions[slot] = d.n_cached
-            tables[slot, :len(d.blocks)] = d.blocks
-            active[slot] = True
         logits, self.kv = self._decode_forward(
             self.params, self.kv, jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(tables), jnp.asarray(active))
+        self.host_dispatches += 1
         # DEVICE-resident: per-slot rows are sliced on device and only
         # fetched when a caller materializes them (query()/np.asarray) —
         # generate()'s sampler consumes them without a host round trip
         return logits[:len(chunks)]
+
+    def _decode_multi_dispatch(self, running: Dict[int, int],
+                               sp: "SamplingParams",
+                               eos_token_id: Optional[int],
+                               rng: jax.Array) -> Optional[Dict[int, List[int]]]:
+        """Steady-state fused decode: up to K tokens per live sequence in ONE
+        device dispatch (``model.decode_multi_forward``).
+
+        ``running`` maps each live uid (input fully drained) to its remaining
+        new-token budget; it is updated in place, and retired sequences are
+        flushed. Returns {uid: emitted tokens} — or ``None`` when the KV pool
+        cannot pre-fund ≥2 steps for the worst case, in which case the caller
+        falls back to the per-token path (which evicts under pressure).
+
+        KV blocks for the worst-case K appends are allocated up front so the
+        block tables are loop-invariant on device; a retiring sequence's
+        unused blocks are released by its flush.
+        """
+        from .model import build_decode_multi_fn
+
+        cfg = self.config
+        uids = list(running)
+        k = cfg.decode_steps_per_dispatch
+
+        def _wants(k_steps: int) -> List[int]:
+            out = []
+            for u in uids:
+                d = self.seqs[u]
+                appends = min(k_steps, running[u],
+                              max(0, cfg.max_context - d.n_cached))
+                out.append(d.blocks_needed(appends, cfg.block_size))
+            return out
+
+        wants = _wants(k)
+        while sum(wants) > self.allocator.free_blocks and k > 2:
+            k = max(2, k // 2)  # odd K: still try K=2 before giving up
+            wants = _wants(k)
+        if k < 2 or sum(wants) > self.allocator.free_blocks:
+            return None
+        for u, w in zip(uids, wants):
+            if w:
+                self.seqs[u].blocks.extend(self.allocator.allocate(w))
+
+        key = (k, sp.structure)
+        fn = self._decode_multi.get(key)
+        if fn is None:
+            fn = self._decode_multi[key] = build_decode_multi_fn(
+                self.model, cfg.block_size, k, sp.structure,
+                cfg.max_context, attn_impl=cfg.decode_attn)
+            while len(self._decode_multi) > self._decode_multi_cap:
+                self._decode_multi.popitem(last=False)
+        else:
+            self._decode_multi.move_to_end(key)
+        s_max = cfg.max_sequences
+        n = len(uids)
+        positions, tables, active = self._slot_arrays(
+            [self.seqs[u] for u in uids])
+        steps_left = np.zeros((s_max,), np.int32)
+        steps_left[:n] = [running[u] for u in uids]
+        stacked = jnp.stack([self.seqs[u].last_logits for u in uids])
+        logits0 = jnp.zeros((s_max, stacked.shape[-1]),
+                            jnp.float32).at[:n].set(stacked)
+
+        toks_d, logits_f, pos_f, act_f, sl_f, self.kv = fn(
+            self.params, self.kv, logits0, jnp.asarray(positions),
+            jnp.asarray(tables), jnp.asarray(active),
+            jnp.asarray(steps_left), rng,
+            jnp.float32(sp.temperature), jnp.float32(sp.top_p),
+            jnp.int32(-1 if eos_token_id is None else eos_token_id))
+        self.host_dispatches += 1
+        self._tick += k
+        # ONE host transfer for the K×S token block + the small state rows
+        toks = np.asarray(toks_d)
+        pos_h = np.asarray(pos_f)
+        act_h = np.asarray(act_f)
+        sl_h = np.asarray(sl_f)
+        emitted: Dict[int, List[int]] = {}
+        for i, u in enumerate(uids):
+            d = self.seqs[u]
+            emitted[u] = [int(t) for t in toks[:, i] if t >= 0]
+            d.n_cached = int(pos_h[i])
+            d.last_scheduled = self._tick
+            if act_h[i]:
+                running[u] = int(sl_h[i])
+                d.last_logits = logits_f[i]
+            else:
+                del running[u]
+                self.flush([u])
+        return emitted
 
     # ------------------------------------------------------------ query/flush
     def query(self, uid: int) -> Optional[jax.Array]:
@@ -426,6 +557,24 @@ class InferenceEngineV2:
         uid_base = 1 << 20  # avoid colliding with caller uids in shared engines
 
         while waiting or running:
+            # 0. steady state — every live sequence decoding and nothing
+            # admissible from the backlog (queue empty, or its head can't be
+            # admitted anyway — engine saturated): fuse up to K decode steps
+            # into one device dispatch (sample + paged-KV append + position
+            # advance all on device); fall through to the per-token path on
+            # KV pressure (it evicts) or mixed state
+            backlog_stuck = bool(waiting) and not self.can_schedule(
+                [uid_base + waiting[0][0]], [len(waiting[0][1])])
+            if (cfg.decode_steps_per_dispatch > 1 and running
+                    and (not waiting or backlog_stuck)
+                    and all(self.query(u) is not None for u in running)):
+                rng, sub = jax.random.split(rng)
+                emitted = self._decode_multi_dispatch(running, sp,
+                                                      eos_token_id, sub)
+                if emitted is not None:
+                    for uid, toks in emitted.items():
+                        results[uid - uid_base].extend(toks)
+                    continue
             # 1. one batched sample over every drained sequence
             put_uids: List[int] = []
             put_toks: List[List[int]] = []
@@ -437,7 +586,10 @@ class InferenceEngineV2:
                 # only the sampled token ids (one int per sequence) cross to
                 # the host — not 2×V floats per sequence per step
                 toks = np.asarray(self._sample_fn(
-                    jnp.stack([lg for _, lg in drained]), sub, sp))
+                    jnp.stack([lg for _, lg in drained]), sub,
+                    jnp.float32(sp.temperature), jnp.float32(sp.top_p),
+                    sp.structure))
+                self.host_dispatches += 1  # the sampler is a dispatch too
                 for (uid, _), tok in zip(drained, toks):
                     tok = int(tok)
                     results[uid - uid_base].append(tok)
