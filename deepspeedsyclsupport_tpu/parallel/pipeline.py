@@ -388,12 +388,6 @@ def spmd_pipeline(layer_fn: Callable,
         f"batch {x.shape[0]} not divisible by n_microbatches {n_micro}")
     mb = x.shape[0] // n_micro
 
-    def microbatch(a):
-        return a.reshape((n_micro, a.shape[0] // n_micro) + a.shape[1:])
-
-    xm = microbatch(x)
-    exm = jax.tree_util.tree_map(microbatch, extras)
-
     # Keep the microbatch dim sharded over the largest prefix of batch_axes
     # that divides it (these axes stay AUTO — the constraint just guides
     # GSPMD; dropping an axis replicates the work across it — warn).
@@ -407,9 +401,32 @@ def spmd_pipeline(layer_fn: Callable,
             "pipeline microbatch size %d not divisible by %s sizes; sharding "
             "over %s only (rest replicated — consider fewer microbatches)",
             mb, batch_axes, kept or "nothing")
-    if kept:
-        xm = jax.lax.with_sharding_constraint(
-            xm, topology.sharding(None, kept))
+
+    def microbatch(a):
+        # STRIDED split (microbatch m = rows {m, n_micro+m, ...}), not
+        # contiguous: the batch arrives sharded over (data, fsdp) on dim 0,
+        # and a contiguous [n_micro, mb, ...] reshape makes GSPMD shard the
+        # *microbatch* dim over data (idle devices per scan step) and then
+        # full-rematerialize against the mb-dim constraint. Splitting
+        # [mb, n_micro, ...] then transposing keeps every device busy on
+        # every microbatch with zero resharding — the reshape preserves the
+        # device order of the batch dim and the transpose just permutes the
+        # sharded dims. Constraints pin BOTH sides of the transpose so GSPMD
+        # can't invent a third layout in between (it otherwise spreads the
+        # mb dim over idle mesh axes and replicate-repartitions against the
+        # pinned side). Row order is restored exactly on the way out.
+        a2 = a.reshape((a.shape[0] // n_micro, n_micro) + a.shape[1:])
+        if kept:
+            a2 = jax.lax.with_sharding_constraint(
+                a2, topology.sharding(kept))
+        out = jnp.swapaxes(a2, 0, 1)
+        if kept:
+            out = jax.lax.with_sharding_constraint(
+                out, topology.sharding(None, kept))
+        return out
+
+    xm = microbatch(x)
+    exm = jax.tree_util.tree_map(microbatch, extras)
 
     # Specs constrain ONLY the manual axis ('pipe'): the stacked layer dim
     # splits into per-stage stacks; activations/extras replicate over pipe.
@@ -424,6 +441,12 @@ def spmd_pipeline(layer_fn: Callable,
                      and jax.default_backend() != "tpu")
     if boundary_cast:
         xm = xm.astype(jnp.float32)
+
+    # re-pin after the cast — a convert between constraint and boundary
+    # gives GSPMD room to pick a different layout and full-rematerialize
+    if kept:
+        xm = jax.lax.with_sharding_constraint(
+            xm, topology.sharding(None, kept))
 
     def body(local_params, xmb, ex):
         # Output lives on the last stage only; broadcast so every pipe rank
@@ -440,6 +463,13 @@ def spmd_pipeline(layer_fn: Callable,
         body, mesh=mesh, axis_names={"pipe"},
         in_specs=(param_specs, P(), ex_specs),
         out_specs=(P(), P()), check_vma=False))(stacked_params, xm, exm)
+    # invert the strided split, pinning both sides of the transpose like on
+    # the way in (the AD transpose of this pair is the warned reshard site)
+    if kept:
+        y = jax.lax.with_sharding_constraint(y, topology.sharding(None, kept))
+    y = jnp.swapaxes(y, 0, 1)
+    if kept:
+        y = jax.lax.with_sharding_constraint(y, topology.sharding(kept))
     y = y.reshape(x.shape)
     return (y, aux.sum()) if with_aux else y
 

@@ -91,10 +91,13 @@ def vocab_parallel_embedding(table, input_ids):
 
     topo = topo_mod._WORLD_TOPOLOGY
     tp = topo.axis_sizes.get("model", 1) if topo is not None else 1
-    try:
-        in_manual_region = lax.axis_size("model") > 0
-    except NameError:
-        in_manual_region = False
+    # ANY manual axis (not just 'model') forbids the nested shard_map: the
+    # ZeRO++ explicit step is manual over {data, fsdp} with 'model' auto, so
+    # probing lax.axis_size('model') alone would miss it and this would
+    # nest a shard_map over already-manual axes (trace error)
+    in_manual_region = bool(
+        set(getattr(jax.sharding.get_abstract_mesh(), "manual_axes",
+                    ()) or ()))
     sizes = topo.axis_sizes if topo is not None else {}
     bdiv = sizes.get("data", 1) * sizes.get("fsdp", 1)
     divisible = (topo is not None
@@ -111,14 +114,22 @@ def vocab_parallel_embedding(table, input_ids):
         return jnp.take(table, input_ids, axis=0)
 
     def body(tbl, ids):
-        # tbl: [V/tp, H/fsdp]; ids: [B/(data·fsdp), S/sp]
+        # tbl: [V/tp, H/fsdp]; ids: [B/(data·fsdp), S/sp]. The batch and the
+        # hidden dim are BOTH fsdp-sharded, so assembling full-hidden rows
+        # takes an all-to-all, not an all-gather: each rank looks up its
+        # hidden slice for every row in its fsdp group, then the a2a sends
+        # row-groups home while concatenating the hidden slices. (A plain
+        # hidden all-gather would pair this rank's rows with OTHER ranks'
+        # rows' hidden slices — corrupted embeddings.)
         vstart = lax.axis_index("model") * tbl.shape[0]
-        local = ids - vstart
+        ids_g = lax.all_gather(ids, "fsdp", axis=0, tiled=True)
+        local = ids_g - vstart
         ok = jnp.logical_and(local >= 0, local < tbl.shape[0])
         x = jnp.take(tbl, jnp.where(ok, local, 0), axis=0)
         x = jnp.where(ok[..., None], x, jnp.zeros_like(x))
         x = lax.psum(x, "model")
-        return lax.all_gather(x, "fsdp", axis=2, tiled=True)
+        return lax.all_to_all(x, "fsdp", split_axis=0, concat_axis=2,
+                              tiled=True)
 
     return jax.shard_map(
         body, mesh=topo.mesh,

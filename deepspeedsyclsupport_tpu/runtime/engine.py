@@ -181,13 +181,16 @@ class Engine:
         if self._zeropp_enabled:
             axes = self.topology.axis_sizes
             n = axes["fsdp"]
-            bad = [a for a in ("model", "pipe", "seq", "expert")
-                   if axes[a] > 1]
+            # TP composes: the explicit step is partially manual over
+            # {data, fsdp} and leaves the model axis to XLA's partitioner
+            # (reference runs hpZ/qwZ with Megatron TP —
+            # ``partition_parameters.py:1551``, ``engine.py:849-858``)
+            bad = [a for a in ("pipe", "seq", "expert") if axes[a] > 1]
             if zc.stage != 3 or n <= 1 or bad:
                 raise ValueError(
-                    f"ZeRO++ flags need stage 3 on a pure data/fsdp mesh "
+                    f"ZeRO++ flags need stage 3 on a data/fsdp[/model] mesh "
                     f"with fsdp>1 (stage={zc.stage}, fsdp={n}, "
-                    f"other axes in use: {bad})")
+                    f"unsupported axes in use: {bad})")
             h = zc.zero_hpz_partition_size
             if h > 1 and n % h:
                 raise ValueError(

@@ -27,9 +27,17 @@ program over the (data, fsdp) mesh:
   identically), with manual global grad-norm clipping (psum of shard square
   sums — optax's ``clip_by_global_norm`` would compute a per-shard norm
   inside shard_map).
+* **TP composition** — the shard_map is *partially manual*: only
+  ``{data, fsdp}`` are manual axes (``axis_names=``); the ``model`` axis
+  stays automatic, so inside the body every TP-sharded dim is seen at its
+  global size and XLA's SPMD partitioner keeps inserting the Megatron-style
+  TP collectives for the forward/backward, exactly as on the pjit path.
+  This mirrors the reference's headline ZeRO++ deployment — hpZ/qwZ on top
+  of Megatron TP (``partition_parameters.py:1551``, engine flags
+  ``runtime/engine.py:849-858``) — without hand-writing the TP collectives.
 
-Scope (asserted by the engine): stage 3, axes {data, fsdp} only (tp/pp/sp/ep
-composition stays on the pjit path, where XLA owns the collectives).
+Scope (asserted by the engine): stage 3, axes {data, fsdp, model}; pp/sp/ep
+composition stays on the pjit path, where XLA owns all the collectives.
 """
 from functools import partial
 from typing import Any, Optional
@@ -45,6 +53,19 @@ from ..comm.quantized import all_to_all_quant_reduce, quantized_all_gather
 from ..comm.comms_logging import comms_logger
 
 AXIS = "fsdp"
+MANUAL = frozenset({"data", "fsdp"})
+
+
+def _manual_spec(spec) -> P:
+    """Strip non-manual mesh axes from a PartitionSpec: partial-manual
+    shard_map in/out specs may only name manual axes; auto axes (model, …)
+    are carried by the outer jit shardings instead."""
+    out = []
+    for s in spec:
+        axes = s if isinstance(s, tuple) else ((s,) if s else ())
+        kept = tuple(a for a in axes if a in MANUAL)
+        out.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+    return P(*out)
 
 
 def _fsdp_dim(spec) -> Optional[int]:
@@ -121,6 +142,22 @@ def build_zeropp_train_fn(engine):
     spec_leaves = jax.tree_util.tree_leaves(param_specs, is_leaf=is_spec)
     batch_spec = P(("data", "fsdp"))
     repl = P()
+    # partial-manual shard_map: specs may only name manual axes — TP (model)
+    # dims are stripped here and ride the outer jit shardings as auto axes
+    manual_param_specs = jax.tree_util.tree_map(
+        _manual_spec, param_specs, is_leaf=is_spec)
+    manual_opt_specs = jax.tree_util.tree_map(
+        _manual_spec, opt_specs, is_leaf=is_spec)
+    # per-device payloads of a leaf are 1/auto_factor of its global-view size
+    auto_sizes = {a: s for a, s in topo.axis_sizes.items()
+                  if a not in MANUAL and s > 1}
+
+    def _auto_factor(spec):
+        f = 1
+        for s in spec:
+            for a in (s if isinstance(s, tuple) else (s,) if s else ()):
+                f *= auto_sizes.get(a, 1)
+        return f
 
     def map_with_specs(f, tree):
         leaves, treedef = jax.tree_util.tree_flatten(tree)
@@ -140,8 +177,9 @@ def build_zeropp_train_fn(engine):
         if k is None:
             return x
         moved = jnp.moveaxis(x, k, 0)
+        local = moved.size // _auto_factor(spec)
         comms_logger.append("zeropp_gather" + ("_int8" if qw else ""),
-                            AXIS, _wire_bytes(moved.size, moved.dtype, qw) * n,
+                            AXIS, _wire_bytes(local, moved.dtype, qw) * n,
                             tuple(moved.shape))
         full = hierarchical_all_gather(moved, n, h, qw, group_size)
         return jnp.moveaxis(full, 0, k)
@@ -153,7 +191,9 @@ def build_zeropp_train_fn(engine):
             return lax.pmean(g, AXIS)
         moved = jnp.moveaxis(g, k, 0)
         comms_logger.append("zeropp_reduce" + ("_int8" if qg else ""),
-                            AXIS, _wire_bytes(moved.size, moved.dtype, qg),
+                            AXIS,
+                            _wire_bytes(moved.size // _auto_factor(spec),
+                                        moved.dtype, qg),
                             tuple(moved.shape))
         if qg:
             shard = all_to_all_quant_reduce(moved, AXIS,
@@ -182,6 +222,8 @@ def build_zeropp_train_fn(engine):
             return loss, metrics, shards
 
         if gas == 1:
+            # raw rng matches the pjit path's gas==1 branch (engine.py) so
+            # dropout masks (and therefore losses) are path-invariant
             loss, metrics, gshards = micro_grads(batch, rng)
             losses = loss[None]
         else:
@@ -249,9 +291,17 @@ def build_zeropp_train_fn(engine):
             body, mesh=topo.mesh,
             # P() prefixes: scaler/rng inputs and the scaler/metrics outputs
             # replicate; their tree structure is whatever the body returns
-            in_specs=(param_specs, opt_specs, repl, batch_specs, repl),
-            out_specs=(param_specs, opt_specs, repl, repl),
+            in_specs=(manual_param_specs, manual_opt_specs, repl,
+                      batch_specs, repl),
+            out_specs=(manual_param_specs, manual_opt_specs, repl, repl),
+            axis_names=MANUAL,
             check_vma=False)
-        return mapped(params, opt_state, scaler, batch, rng)
+        new_p, new_o, new_s, metrics = mapped(params, opt_state, scaler,
+                                              batch, rng)
+        # pin the auto (TP) dims of the outputs back to the engine layout so
+        # the donated buffers round-trip with no per-step resharding
+        new_p = jax.lax.with_sharding_constraint(new_p, engine.param_shardings)
+        new_o = jax.lax.with_sharding_constraint(new_o, engine.opt_shardings)
+        return new_p, new_o, new_s, metrics
 
     return jax.jit(fn, donate_argnums=(0, 1, 2))
