@@ -54,6 +54,11 @@ class RaggedInferenceConfig:
     # scheduling + dispatch across K tokens — the steady-state analog of
     # the reference's ragged-kernel amortization
     decode_steps_per_dispatch: int = 1
+    # KV-pool head-dim lane alignment (kv_cache.lane_padded_head_dim):
+    # None = auto (round up to 128 on TPU — Mosaic DMA slices must be
+    # lane-tile aligned; no padding elsewhere); an int forces that multiple.
+    # HBM note: a d=64 model pays 2x KV pool on TPU for kernel decode.
+    head_dim_lane_pad: Optional[int] = None
 
     def __post_init__(self):
         if not isinstance(self.prefill_attn, str) or not self.prefill_attn:

@@ -22,7 +22,27 @@ class BlockedKV(NamedTuple):
         return self.k.shape[1]
 
 
+def lane_padded_head_dim(head_dim: int, pad) -> int:
+    """Mosaic constraint: the paged kernels DMA-slice the pool, and slice
+    shapes must be lane-tile (128) aligned — head dims below/off 128 fail to
+    compile on real TPU silicon ("Slice shape along dimension 2 must be
+    aligned to tiling (128)"). The pool is therefore allocated with the head
+    dim rounded up to the lane width on TPU; q/k/v are zero-padded at the
+    attention seam (q pre-scaled by sqrt(d_pad/d) to compensate the impls'
+    1/sqrt(trailing-dim) softmax scale) and the output sliced back, which
+    leaves scores mathematically identical. ``pad`` None/0 = auto (128 on
+    TPU, none
+    elsewhere). HBM note: a d=64 model pays 2x KV pool for kernel decode."""
+    import jax
+
+    if pad in (None, 0):
+        pad = 128 if jax.default_backend() == "tpu" else 1
+    return -(-head_dim // pad) * pad
+
+
 def init_blocked_kv(model_config, cfg: RaggedInferenceConfig) -> BlockedKV:
+    d = lane_padded_head_dim(model_config.head_dim,
+                             getattr(cfg, "head_dim_lane_pad", None))
     shape = (model_config.num_layers, cfg.num_blocks * cfg.block_size,
-             model_config.num_kv_heads, model_config.head_dim)
+             model_config.num_kv_heads, d)
     return BlockedKV(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))

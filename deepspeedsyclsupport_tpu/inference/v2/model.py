@@ -96,6 +96,21 @@ def _attn_out(p, attn, cfg, n):
     return out
 
 
+def _lane_pad(x, d_pad: int, is_q: bool = False):
+    """Zero-pad the trailing head dim to the cache pool's lane-padded width
+    (see ``kv_cache.lane_padded_head_dim``). Zero lanes cannot change q·k
+    dot products, but every attention impl derives its softmax scale from
+    the (padded) trailing dim — so q is pre-scaled by sqrt(d_pad/d), making
+    scores/softmax mathematically identical to the unpadded computation (up
+    to one fp rounding on q). The attention output is sliced back."""
+    d = x.shape[-1]
+    if d == d_pad:
+        return x
+    if is_q:
+        x = x * np.sqrt(d_pad / d).astype(x.dtype)
+    return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, d_pad - d)])
+
+
 def _positionize(cfg, q, k, positions):
     if cfg.pos_embed == "rope":
         q = apply_rope(q[None], positions[None], cfg.rope_theta,
@@ -358,6 +373,9 @@ def ragged_forward(model, params: Any, kv: BlockedKV, tokens, token_seq,
             nonlocal k_cache, v_cache
             q, k, v = _qkv(p["attn"], y, cfg, t)
             q, k = _positionize(cfg, q, k, token_pos)
+            d_pool = k_cache.shape[-1]
+            q = _lane_pad(q, d_pool, is_q=True)
+            k, v = _lane_pad(k, d_pool), _lane_pad(v, d_pool)
             k_cache = k_cache.at[dest].set(k.astype(k_cache.dtype),
                                            mode="drop")
             v_cache = v_cache.at[dest].set(v.astype(v_cache.dtype),
@@ -369,7 +387,7 @@ def ragged_forward(model, params: Any, kv: BlockedKV, tokens, token_seq,
                 atom_qidx=atom_qidx, atom_pos0=atom_pos0,
                 atom_qlen=atom_qlen, atom_tables=atom_tables,
                 atom_inv=atom_inv)
-            return spec.fn(q, ctx)
+            return spec.fn(q, ctx)[..., :cfg.head_dim]
 
         x = _block(cfg, p, x, attn_fn)
         return x, (k_cache, v_cache)
@@ -425,13 +443,17 @@ def decode_forward(model, params: Any, kv: BlockedKV, tokens, positions,
             nonlocal k_cache, v_cache
             q, k, v = _qkv(p["attn"], y, cfg, s)
             q, k = _positionize(cfg, q, k, positions)
+            d_pool = k_cache.shape[-1]
+            q = _lane_pad(q, d_pool, is_q=True)
+            k, v = _lane_pad(k, d_pool), _lane_pad(v, d_pool)
             k_cache = k_cache.at[dest].set(k.astype(k_cache.dtype),
                                            mode="drop")
             v_cache = v_cache.at[dest].set(v.astype(v_cache.dtype),
                                            mode="drop")
             return spec.fn(q, DecodeAttnContext(
                 k_cache=k_cache, v_cache=v_cache, block_tables=block_tables,
-                seq_lens=seq_lens, block_size=bs, alibi=ab, window=window))
+                seq_lens=seq_lens, block_size=bs, alibi=ab,
+                window=window))[..., :cfg.head_dim]
 
         x = _block(cfg, p, x, attn_fn)
         return x, (k_cache, v_cache)

@@ -172,6 +172,26 @@ class TestEngineV2:
         assert eng.allocator.free_blocks > used  # blocks returned
         assert eng.query(11) is None
 
+    def test_lane_padded_kv_pool_parity(self, tiny):
+        """Mosaic requires the paged-kernel pool's head dim be lane-tile
+        (128) aligned on real TPU; the pool is allocated padded, q/k/v
+        padded at the attention seam with q pre-scaled to compensate the
+        impls' 1/sqrt(padded-dim) softmax scale
+        (kv_cache.lane_padded_head_dim). Forcing the padding on the CPU sim
+        must leave LOGITS numerically equal to the unpadded engine — greedy
+        alone could mask a mis-scaled softmax (caught in review: the scale
+        used to come from the padded dim, a 2.8x colder softmax at d=16)."""
+        model, params = tiny
+        prompt = [1, 5, 9, 200, 3]
+        base = np.asarray(_v2(model, params).put([1], [prompt])[1])
+        eng = _v2(model, params, head_dim_lane_pad=128)
+        assert eng.kv.k.shape[-1] == 128  # pool really is padded
+        got = np.asarray(eng.put([1], [prompt])[1])
+        np.testing.assert_allclose(got, base, rtol=1e-5, atol=1e-5)
+        want = _naive_greedy(model, params, prompt, 6)
+        toks = eng.generate([prompt], max_new_tokens=6)[0]
+        assert list(toks) == want, (toks, want)
+
     def test_expert_and_tensor_parallel_serving_parity(self):
         """MoE serving over an expert-parallel (and TP-composed) topology —
         the reference's DeepSpeedMoEInference EP story: declarative expert
