@@ -1,0 +1,130 @@
+"""On-silicon kernel triage + block-size autotune (run when the TPU tunnel
+is up; each section prints one JSON line, so partial windows still bank
+evidence).
+
+Sections, cheapest first:
+  calib   — XLA matmul at known-FLOP shapes: separates tunnel/dispatch
+            overhead from device compute (a 1.1 TFLOP matmul at v5e peak is
+            ~6 ms; if measured time is tens of ms, the gap is dispatch).
+  flash   — flash-attention block_q/block_k sweep at the bench shape.
+  paged   — paged-decode block_size sweep at serving shapes.
+
+Usage:  python tools/tpu_tune.py [calib|flash|paged|all]
+"""
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+V5E_PEAK = 197e12
+
+
+def _sync(x):
+    return float(np.asarray(x).reshape(-1)[0])
+
+
+def bench(fn, args, iters=10):
+    out = fn(*args)
+    _sync(out[0] if isinstance(out, tuple) else out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _sync(out[0] if isinstance(out, tuple) else out)
+    return (time.perf_counter() - t0) / iters
+
+
+def emit(section, **kw):
+    print(json.dumps({"section": section, **kw}), flush=True)
+
+
+def calib():
+    key = jax.random.PRNGKey(0)
+    rows = []
+    for n in (2048, 4096, 8192):
+        a = jax.random.normal(key, (n, n), jnp.bfloat16)
+        f = jax.jit(lambda a, b: a @ b)
+        dt = bench(f, (a, a))
+        fl = 2 * n ** 3
+        rows.append({"matmul": n, "ms": round(dt * 1e3, 3),
+                     "tflops": round(fl / dt / 1e12, 1),
+                     "peak_frac": round(fl / dt / V5E_PEAK, 3)})
+    # dispatch floor: a trivial add, timed the same way
+    x = jnp.ones((8, 128), jnp.bfloat16)
+    dt0 = bench(jax.jit(lambda x: x + 1), (x,), iters=20)
+    emit("calib", platform=jax.devices()[0].platform,
+         dispatch_floor_ms=round(dt0 * 1e3, 3), matmuls=rows)
+
+
+def flash():
+    from deepspeedsyclsupport_tpu.ops import flash_attention as fa
+
+    b, s, h, d = 4, 2048, 16, 128
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, s, h, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, s, h, d), jnp.bfloat16)
+    fl = 4 * b * h * s * s * d * 0.5
+    rows = []
+    best = None
+    for bq in (128, 256, 512, 1024):
+        for bk in (128, 256, 512, 1024):
+            if bq > s or bk > s:
+                continue
+            try:
+                f = jax.jit(lambda q, k, v, bq=bq, bk=bk: fa.flash_attention(
+                    q, k, v, causal=True, block_q=bq, block_k=bk))
+                dt = bench(f, (q, k, v), iters=5)
+            except Exception as e:
+                rows.append({"bq": bq, "bk": bk,
+                             "error": str(e)[:120]})
+                continue
+            tf = fl / dt / 1e12
+            rows.append({"bq": bq, "bk": bk, "ms": round(dt * 1e3, 2),
+                         "tflops": round(tf, 1)})
+            if best is None or tf > best["tflops"]:
+                best = rows[-1]
+    emit("flash", shape=[b, s, h, d], best=best, sweep=rows)
+
+
+def paged():
+    from deepspeedsyclsupport_tpu.ops.paged_attention import (
+        paged_decode_attention_pallas)
+
+    h, kvh, d = 16, 4, 128
+    nseq, ctx = 32, 1024
+    rows = []
+    for bs in (32, 64, 128, 256):
+        bps = ctx // bs
+        slots = nseq * ctx
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(ks[0], (nseq, h, d), jnp.bfloat16)
+        kc = jax.random.normal(ks[1], (slots, kvh, d), jnp.bfloat16)
+        vc = jax.random.normal(ks[2], (slots, kvh, d), jnp.bfloat16)
+        bt = jnp.arange(nseq * bps, dtype=jnp.int32).reshape(nseq, bps)
+        sl = jnp.full((nseq,), ctx, jnp.int32)
+        try:
+            f = jax.jit(lambda *a, bs=bs: paged_decode_attention_pallas(
+                *a, block_size=bs))
+            dt = bench(f, (q, kc, vc, bt, sl), iters=10)
+        except Exception as e:
+            rows.append({"block_size": bs, "error": str(e)[:120]})
+            continue
+        kv_bytes = 2 * nseq * ctx * kvh * d * 2
+        rows.append({"block_size": bs, "ms": round(dt * 1e3, 3),
+                     "kv_gbps": round(kv_bytes / dt / 1e9, 1),
+                     "tok_per_s": round(nseq / dt, 0)})
+    emit("paged", shape={"nseq": nseq, "ctx": ctx, "h": h, "kvh": kvh,
+                         "d": d}, sweep=rows)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("calib", "all"):
+        calib()
+    if which in ("flash", "all"):
+        flash()
+    if which in ("paged", "all"):
+        paged()
