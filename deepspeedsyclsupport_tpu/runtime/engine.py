@@ -195,9 +195,8 @@ class Engine:
             if h > 1 and n % h:
                 raise ValueError(
                     f"zero_hpz_partition_size {h} must divide fsdp {n}")
-            if zc.offload_optimizer.enabled or zc.offload_param.enabled:
-                raise ValueError("ZeRO++ explicit path and offload are "
-                                 "mutually exclusive for now")
+            # offload composes: the explicit step's grads-only variant
+            # feeds the host-resident master update (_build_grads_batch_fn)
 
         # ---------------------------------------------------------- optimizer
         sched_cfg = self.config.scheduler
@@ -547,6 +546,10 @@ class Engine:
 
     def _build_grads_batch_fn(self):
         """Device half of the offloaded step: scan microbatches → grads."""
+        if self._zeropp_enabled:
+            from .zeropp import build_zeropp_grads_fn
+
+            return build_zeropp_grads_fn(self)
         gas = self.config.gradient_accumulation_steps
 
         def grads_fn(params, scaler, batch, rng):

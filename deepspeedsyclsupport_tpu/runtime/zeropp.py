@@ -118,8 +118,18 @@ def hierarchical_all_gather(x: jnp.ndarray, n: int, h: int,
     return full.reshape((n * shard,) + x.shape[1:])
 
 
-def build_zeropp_train_fn(engine):
-    """Drop-in replacement for ``Engine._build_train_batch_fn`` output."""
+def build_zeropp_grads_fn(engine):
+    """Device half of a ZeRO++ step under ZeRO-Offload: same explicit
+    gather/reduce body, but grads (still loss-scaled, fsdp-sharded layout)
+    are RETURNED for the host-resident fp32 master update instead of being
+    applied on device (``Engine._build_grads_batch_fn`` contract; reference
+    composes ZeRO++ flags with offload through the same stage-3 engine)."""
+    return build_zeropp_train_fn(engine, with_update=False)
+
+
+def build_zeropp_train_fn(engine, with_update: bool = True):
+    """Drop-in replacement for ``Engine._build_train_batch_fn`` output
+    (or, ``with_update=False``, for ``_build_grads_batch_fn``)."""
     cfg = engine.config
     topo = engine.topology
     n = topo.axis_sizes["fsdp"]
@@ -134,9 +144,14 @@ def build_zeropp_train_fn(engine):
     param_specs = jax.tree_util.tree_map(
         lambda s: s.spec, engine.param_shardings,
         is_leaf=lambda x: hasattr(x, "spec"))
-    opt_specs = jax.tree_util.tree_map(
-        lambda s: s.spec, engine.opt_shardings,
-        is_leaf=lambda x: hasattr(x, "spec"))
+    # under offload the optimizer state lives host-side (plain device
+    # placements, or None for multi-host) — the grads-only variant never
+    # touches it
+    opt_specs = None
+    if with_update:
+        opt_specs = jax.tree_util.tree_map(
+            lambda s: s.spec, engine.opt_shardings,
+            is_leaf=lambda x: hasattr(x, "spec"))
     # PartitionSpec may itself be a pytree: pair leaves positionally instead
     # of tree_map-ing over mixed structures
     spec_leaves = jax.tree_util.tree_leaves(param_specs, is_leaf=is_spec)
@@ -146,8 +161,9 @@ def build_zeropp_train_fn(engine):
     # dims are stripped here and ride the outer jit shardings as auto axes
     manual_param_specs = jax.tree_util.tree_map(
         _manual_spec, param_specs, is_leaf=is_spec)
-    manual_opt_specs = jax.tree_util.tree_map(
+    manual_opt_specs = (jax.tree_util.tree_map(
         _manual_spec, opt_specs, is_leaf=is_spec)
+        if opt_specs is not None else None)
     # per-device payloads of a leaf are 1/auto_factor of its global-view size
     auto_sizes = {a: s for a, s in topo.axis_sizes.items()
                   if a not in MANUAL and s > 1}
@@ -203,7 +219,12 @@ def build_zeropp_train_fn(engine):
                                      tiled=True) / n
         return jnp.moveaxis(shard, 0, k)
 
-    def body(params, opt_state, scaler, batch, rng):
+    global_mean = lambda m: lax.pmean(lax.pmean(m, "data"), AXIS)
+
+    def compute_gshards(params, scaler, batch, rng):
+        """Shared device half: qwZ/hpZ gather → microbatch grads → qgZ
+        reduce-scatter → DP mean. Grads come back still loss-SCALED (both
+        consumers unscale: the fused body below, the host-offload apply)."""
         full_params = map_with_specs(gather_leaf, params)
 
         def micro_grads(mb, r):
@@ -242,13 +263,23 @@ def build_zeropp_train_fn(engine):
         # DP average (grads identical across fsdp shards by construction)
         gshards = jax.tree_util.tree_map(lambda g: lax.pmean(g, "data"),
                                          gshards)
+        return gshards, losses, metrics
+
+    def body(params, opt_state, scaler, batch, rng):
+        gshards, losses, metrics = compute_gshards(params, scaler, batch,
+                                                   rng)
         gshards = unscale_grads(gshards, scaler)
 
-        # overflow + global norm across shards
+        # overflow check gated on fp16 exactly like the pjit and offload
+        # paths (_apply_grads): the skip-on-overflow protocol is a loss-
+        # scaler feature; bf16/fp32 training never skips
         leaves = jax.tree_util.tree_leaves(gshards)
-        finite_local = jnp.all(jnp.stack([jnp.isfinite(g).all()
-                                          for g in leaves]))
-        finite = lax.pmin(finite_local.astype(jnp.int32), AXIS) > 0
+        if engine.fp16_enabled:
+            finite_local = jnp.all(jnp.stack([jnp.isfinite(g).all()
+                                              for g in leaves]))
+            finite = lax.pmin(finite_local.astype(jnp.int32), AXIS) > 0
+        else:
+            finite = jnp.asarray(True)
         # sharded leaves partition the square-sum across fsdp (psum restores
         # the global norm); replicated leaves contribute once
         dims = [_fsdp_dim(s) for s in spec_leaves]
@@ -266,7 +297,6 @@ def build_zeropp_train_fn(engine):
         new_params, new_opt, new_scaler = engine._finish_update(
             params, opt_state, scaler, gshards, finite)
         # user metrics are shard-local batch means — reduce like the loss
-        global_mean = lambda m: lax.pmean(lax.pmean(m, "data"), AXIS)
         out_metrics = {
             **jax.tree_util.tree_map(global_mean, metrics),
             "loss": global_mean(losses.mean()),
@@ -284,6 +314,33 @@ def build_zeropp_train_fn(engine):
             # a (gas,) vector) replicate — they carry no batch dimension
             return P(*([None] * nd))
         return P(*lead, *([None] * (nd - len(lead))))
+
+    if not with_update:
+        def grads_body(params, scaler, batch, rng):
+            gshards, losses, metrics = compute_gshards(params, scaler,
+                                                       batch, rng)
+            metrics = jax.tree_util.tree_map(global_mean, metrics)
+            return gshards, global_mean(losses), metrics
+
+        def grads_fn(params, scaler, batch, rng):
+            batch_specs = jax.tree_util.tree_map(make_batch_spec, batch)
+            mapped = jax.shard_map(
+                grads_body, mesh=topo.mesh,
+                in_specs=(manual_param_specs, repl, batch_specs, repl),
+                out_specs=(manual_param_specs, repl, repl),
+                axis_names=MANUAL,
+                check_vma=False)
+            gshards, losses, metrics = mapped(params, scaler, batch, rng)
+            # pin the auto (TP) dims like the sibling paths do — the
+            # multi-host offload consumer pairs gradient blocks to master
+            # shards by exact shard-index keys from grad_shardings, so the
+            # layout must not be left to XLA inference
+            if engine.grad_shardings is not None:
+                gshards = jax.lax.with_sharding_constraint(
+                    gshards, engine.grad_shardings)
+            return gshards, losses, metrics
+
+        return jax.jit(grads_fn)
 
     def fn(params, opt_state, scaler, batch, rng):
         batch_specs = jax.tree_util.tree_map(make_batch_spec, batch)
