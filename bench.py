@@ -96,6 +96,39 @@ def _bench_loop(fn, args, iters):
     return (time.perf_counter() - t0) / iters
 
 
+def _bench_chain(fn_one, x0, extra_args, iters):
+    """Per-iteration device time of ``fn_one(x, *extra) -> x'`` measured as
+    ``iters`` data-dependent applications inside ONE jitted fori_loop — a
+    single dispatch, so remote-tunnel per-call latency (several ms on the
+    axon path, enough to swamp a sub-ms kernel) cancels out. The chained
+    data dependency defeats CSE/DCE. The one-dispatch floor is measured
+    separately and subtracted."""
+    import jax
+    from jax import lax
+
+    def chained(x, extra):
+        return lax.fori_loop(0, iters, lambda i, xx: fn_one(xx, *extra), x)
+
+    def best_of(f, n=3):
+        _sync(f(x0, extra_args))    # compile/warm
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            _sync(f(x0, extra_args))
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    total = best_of(jax.jit(chained))
+    # dispatch floor: same structure, 1 iteration
+    floor = best_of(jax.jit(lambda x, extra: fn_one(x, *extra)))
+    if total <= floor or iters < 2:
+        # tunnel jitter swamped the kernel — the difference of two noisy
+        # samples is meaningless; report the per-dispatch bound honestly
+        # instead of clamping to an absurd number
+        return floor, "dispatch_bound"
+    return (total - floor) / (iters - 1), "chained"
+
+
 def _dense_attn_ref(q, k, v, causal=True):
     import jax
     import jax.numpy as jnp
@@ -170,15 +203,16 @@ def run_kernels_micro():
     q = jax.random.normal(ks[0], (b, s, h, d), jnp.bfloat16)
     k = jax.random.normal(ks[1], (b, s, h, d), jnp.bfloat16)
     v = jax.random.normal(ks[2], (b, s, h, d), jnp.bfloat16)
-    fwd = jax.jit(lambda q, k, v: fa.flash_attention(q, k, v, causal=True))
-    dt = _bench_loop(fwd, (q, k, v), 2 if smoke else 10)
+    dt, how = _bench_chain(
+        lambda x, k, v: fa.flash_attention(x, k, v, causal=True),
+        q, (k, v), 4 if smoke else 10)
     tflops = 4 * b * h * s * s * d * 0.5 / dt / 1e12
     _emit({"metric": "kernel_micro_flash_fwd", "value": round(tflops, 2),
            "unit": "TFLOP/s",
            "vs_baseline": round(tflops * 1e12 / peak / REFERENCE_MFU, 4),
            "detail": {"platform": platform, "shape": [b, s, h, d],
                       "dtype": "bfloat16", "parity_max_rel_err": err,
-                      "parity_ok": err < 5e-2,
+                      "parity_ok": err < 5e-2, "timing": how,
                       "wall_s": round(time.perf_counter() - t0, 1),
                       "baseline": "fraction of chip peak vs reference "
                                   "54% MFU"}})
@@ -228,8 +262,9 @@ def run_kernels():
     q = jax.random.normal(ks[0], (b, s, h, d), jnp.bfloat16)
     k = jax.random.normal(ks[1], (b, s, h, d), jnp.bfloat16)
     v = jax.random.normal(ks[2], (b, s, h, d), jnp.bfloat16)
-    fwd = jax.jit(lambda q, k, v: fa.flash_attention(q, k, v, causal=True))
-    dt = _bench_loop(fwd, (q, k, v), 20)
+    dt, how = _bench_chain(
+        lambda x, k, v: fa.flash_attention(x, k, v, causal=True),
+        q, (k, v), 20)
     flops_fwd = 4 * b * h * s * s * d * 0.5  # 2 matmuls, causal half
     tflops = flops_fwd / dt / 1e12
     _emit({"metric": "kernel_flash_fwd", "value": round(tflops, 2),
@@ -237,13 +272,23 @@ def run_kernels():
            "vs_baseline": round(tflops * 1e12 / peak / REFERENCE_MFU, 4),
            "detail": {"platform": platform, "shape": [b, s, h, d],
                       "dtype": "bfloat16", "parity_max_rel_err": fwd_err,
-                      "parity_ok": fwd_err < 5e-2,
+                      "parity_ok": fwd_err < 5e-2, "timing": how,
                       "baseline": "fraction of chip peak vs reference 54% MFU"}})
 
-    bwd = jax.jit(jax.grad(
+    bwd_one = jax.grad(
         lambda q, k, v: fa.flash_attention(q, k, v, causal=True)
-        .astype(jnp.float32).sum(), (0, 1, 2)))
-    dt = _bench_loop(bwd, (q, k, v), 10)
+        .astype(jnp.float32).sum(), (0, 1, 2))
+
+    def bwd_step(x, k, v):
+        # fold dk/dv into the carry with an epsilon term so the dk/dv
+        # pallas_call stays LIVE (chaining dq alone lets XLA dead-code the
+        # second backward kernel and inflates the reported TFLOP/s)
+        dq, dk, dv = bwd_one(x, k, v)
+        eps = (dk.astype(jnp.float32).sum()
+               + dv.astype(jnp.float32).sum()) * jnp.float32(1e-30)
+        return (dq.astype(jnp.float32) + eps).astype(x.dtype)
+
+    dt, how = _bench_chain(bwd_step, q, (k, v), 10)
     flops_fb = flops_fwd * 3.5  # grad call = fwd (2 matmuls) + bwd (5)
     tflops = flops_fb / dt / 1e12
     _emit({"metric": "kernel_flash_bwd", "value": round(tflops, 2),
@@ -251,7 +296,7 @@ def run_kernels():
            "vs_baseline": round(tflops * 1e12 / peak / REFERENCE_MFU, 4),
            "detail": {"platform": platform, "shape": [b, s, h, d],
                       "dtype": "bfloat16", "parity_max_rel_err": bwd_err,
-                      "parity_ok": bwd_err < 5e-2,
+                      "parity_ok": bwd_err < 5e-2, "timing": how,
                       "baseline": "fraction of chip peak vs reference 54% MFU"}})
 
     # -------- ragged paged prefill: parity (f32, GQA) --------------------
@@ -270,9 +315,10 @@ def run_kernels():
             else [2048, 1536, 1024, 1024, 512, 512, 256, 256])
     at = _make_atoms(lens, 128, 64, 16, 16, 128, jax.random.PRNGKey(2),
                      jnp.bfloat16)
-    kern = jax.jit(functools.partial(pa.ragged_prefill_attention_pallas,
-                                     block_size=64, interpret=interp))
-    dt = _bench_loop(kern, at, 2 if smoke else 10)
+    pre_one = functools.partial(pa.ragged_prefill_attention_pallas,
+                                block_size=64, interpret=interp)
+    dt, how = _bench_chain(lambda x, *rest: pre_one(x, *rest).astype(x.dtype),
+                           at[0], tuple(at[1:]), 4 if smoke else 10)
     flops = sum(2 * 16 * 128 * ln * ln for ln in lens)  # causal half of 4
     tflops = flops / dt / 1e12
     _emit({"metric": "kernel_ragged_prefill", "value": round(tflops, 2),
@@ -280,7 +326,7 @@ def run_kernels():
            "vs_baseline": round(tflops * 1e12 / peak / REFERENCE_MFU, 4),
            "detail": {"platform": platform, "seq_lens": lens,
                       "dtype": "bfloat16", "parity_max_rel_err": pre_err,
-                      "parity_ok": pre_err < 5e-2,
+                      "parity_ok": pre_err < 5e-2, "timing": how,
                       "baseline": "fraction of chip peak vs reference 54% MFU"}})
 
     # -------- paged decode: parity (f32) then bandwidth (bf16) -----------
@@ -304,9 +350,10 @@ def run_kernels():
     slots, bps, block, h, d = ((4, 2, 16, 2, 64) if smoke
                                else (64, 16, 64, 16, 128))
     args = decode_setup(slots, bps, block, h, h, d, jnp.bfloat16, 4)
-    kern = jax.jit(functools.partial(pa.paged_decode_attention_pallas,
-                                     block_size=block, interpret=interp))
-    dt = _bench_loop(kern, args, 2 if smoke else 20)
+    dec_one = functools.partial(pa.paged_decode_attention_pallas,
+                                block_size=block, interpret=interp)
+    dt, how = _bench_chain(lambda x, *rest: dec_one(x, *rest).astype(x.dtype),
+                           args[0], tuple(args[1:]), 4 if smoke else 20)
     bytes_moved = slots * bps * block * h * d * 2 * 2  # K+V, bf16
     gbps = bytes_moved / dt / 1e9
     _emit({"metric": "kernel_paged_decode", "value": round(gbps, 1),
@@ -315,7 +362,7 @@ def run_kernels():
            "detail": {"platform": platform,
                       "slots": slots, "context": bps * block,
                       "dtype": "bfloat16", "parity_max_rel_err": dec_err,
-                      "parity_ok": dec_err < 5e-2,
+                      "parity_ok": dec_err < 5e-2, "timing": how,
                       "baseline": "fraction of HBM peak bandwidth "
                                   "(decode attention is BW-bound)"}})
 

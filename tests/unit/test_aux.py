@@ -445,10 +445,11 @@ class TestBenchLadder:
         # kernels_micro now runs FIRST on TPU (banks compiled-kernel
         # evidence before anything can hang)
         assert rungs == ["probe", "kernels_micro", "kernels", "train",
-                         "serve"]
+                         "serve", "serve_goodput"]
         # kernels timed out → remaining rungs run pinned to CPU
         assert seen[3][1].get("JAX_PLATFORMS") == "cpu"
         assert seen[4][1].get("JAX_PLATFORMS") == "cpu"
+        assert seen[5][1].get("JAX_PLATFORMS") == "cpu"
         lines = capsys.readouterr().out.strip().splitlines()
         head = _json.loads(lines[-1])
         # aggregated headline: train wins, serve recorded under rungs,
