@@ -368,7 +368,8 @@ def model_flops_per_token(cfg):
     return 6 * n_active, attn
 
 
-def _measure(name, seq, micro_bs, steps, remat, platform):
+def _measure(name, seq, micro_bs, steps, remat, platform,
+             attn_impl="auto"):
     """One bench rung: build → warmup/compile → timed steps → metrics dict.
     Raises on OOM/compile failure; the caller's ladder steps down."""
     import jax
@@ -378,7 +379,8 @@ def _measure(name, seq, micro_bs, steps, remat, platform):
     from deepspeedsyclsupport_tpu.comm.topology import reset_world_topology
     from deepspeedsyclsupport_tpu.models import build_model, get_config
 
-    cfg = get_config(name, remat=remat, max_seq_len=seq)
+    cfg = get_config(name, remat=remat, max_seq_len=seq,
+                     attn_impl=attn_impl)
     reset_world_topology()
     topo = ds.build_topology(dp=1)
     model = build_model(cfg)
@@ -417,6 +419,7 @@ def _measure(name, seq, micro_bs, steps, remat, platform):
         "detail": {"platform": platform, "mfu": round(mfu, 4),
                    "tflops": round(achieved / 1e12, 2),
                    "micro_bs": micro_bs, "remat": remat,
+                   "attn_impl": attn_impl,
                    "baseline": "achieved MFU vs reference 54% (Ulysses "
                                "175/312 TFLOPs on A100)",
                    "loss": round(float(np.asarray(m["loss"])), 4)},
@@ -445,11 +448,21 @@ def run_train():
 
     import gc
 
+    t_start = time.monotonic()
+    # variants must START early enough to FINISH inside the parent's
+    # _spawn timeout (1200 s): a variant is a fresh compile (~2 min) +
+    # timed steps, so leave ~half the window as headroom — an optional
+    # A-B overrunning the child would read as a tunnel timeout upstream
+    # and degrade every remaining TPU rung to CPU
+    budget = float(os.environ.get("DSTPU_TRAIN_BUDGET", 600))
     last_err = None
+    base = None
     for name, seq, micro, steps, remat in ladder:
         try:
-            _emit(_measure(name, seq, micro, steps, remat, platform))
-            return
+            r = _measure(name, seq, micro, steps, remat, platform)
+            _emit(r)
+            base = (name, seq, micro, steps, remat, r)
+            break
         except Exception as e:  # OOM / compile failure → next rung
             last_err = f"{name} micro={micro} remat={remat}: {str(e)[:300]}"
             print(f"bench rung failed: {last_err}", file=sys.stderr)
@@ -457,7 +470,34 @@ def run_train():
         # exception traceback pins the engine's frames until cleared)
         gc.collect()
         jax.clear_caches()
-    raise RuntimeError(f"all train rungs failed; last: {last_err}")
+    if base is None:
+        raise RuntimeError(f"all train rungs failed; last: {last_err}")
+    # A-B the big perf levers inside the remaining budget: attention impl
+    # (flash Pallas vs XLA's fused attention at this seq) and remat off
+    # (recompute pass vs activation memory). The parent headlines the BEST
+    # train line, so a faster variant directly moves the round's number.
+    if platform == "tpu":
+        name, seq, micro, steps, remat, _ = base
+        variants = ([("xla_attn", dict(attn_impl="xla")),
+                     ("noremat", dict(remat=False))] if remat
+                    else [("xla_attn", dict(attn_impl="xla"))])
+        for tag, kw in variants:
+            if time.monotonic() - t_start > budget:
+                print("train variant skipped (budget)", file=sys.stderr)
+                break
+            # free the previous engine's executables/caches BEFORE the
+            # next full compile — llama2-1b sits at the edge of the chip
+            gc.collect()
+            jax.clear_caches()
+            try:
+                r = _measure(name, seq, micro, steps,
+                             kw.get("remat", remat), platform,
+                             attn_impl=kw.get("attn_impl", "auto"))
+                r["metric"] += f"_{tag}"  # unique metric per variant
+                _emit(r)
+            except Exception as e:
+                print(f"train variant {tag} failed: {str(e)[:200]}",
+                      file=sys.stderr)
 
 
 # ======================================================================
@@ -1307,11 +1347,22 @@ def main():
 
     # final aggregated headline: the train number if we have one, else
     # serve, else the best kernel line — with every rung under detail.rungs
+    def best_train(lines):
+        """The train rung A-Bs perf levers (attn impl, remat) — the best
+        variant is the round's number. MFU ratios only compare within a
+        platform, so prefer the TPU subset when it exists."""
+        tpu = [r for r in lines
+               if r.get("detail", {}).get("platform") == "tpu"]
+        pool = tpu or lines
+        return max(pool, key=lambda r: r.get("vs_baseline") or 0.0)
+
     def pick(prefix):
-        for r in all_results:
-            if r["metric"].startswith(prefix):
-                return r
-        return None
+        cands = [r for r in all_results if r["metric"].startswith(prefix)]
+        if not cands:
+            return None
+        if prefix == "train":
+            return best_train(cands)
+        return cands[0]
 
     head = pick("train") or pick("serve") or pick("kernel")
     if head is None:
@@ -1327,10 +1378,11 @@ def main():
                  if r.get("detail", {}).get("platform") == "tpu"]
     if head.get("detail", {}).get("platform") != "tpu" and tpu_lines:
         for prefix in ("train", "serve", "kernel"):
-            cand = next((r for r in tpu_lines
-                         if r["metric"].startswith(prefix)), None)
-            if cand is not None:
-                head = cand
+            cands = [r for r in tpu_lines
+                     if r["metric"].startswith(prefix)]
+            if cands:
+                # same best-variant rule as pick() — not emission order
+                head = best_train(cands) if prefix == "train" else cands[0]
                 break
     rest = [r for r in all_results if r is not head]
     head = dict(head)
