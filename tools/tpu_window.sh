@@ -1,0 +1,43 @@
+#!/bin/bash
+# Tunnel-window playbook: run when the axon TPU answers, cheapest and most
+# informative first; every step appends to the log so a window that dies
+# mid-run still banks everything before it.
+set -u
+LOG=${1:-/tmp/tpu_window_$(date +%H%M).log}
+cd "$(dirname "$0")/.."
+echo "=== tpu window $(date -u) ===" | tee -a "$LOG"
+
+run() {  # run <tag> <timeout_s> <cmd...>
+  echo "--- $1 ($(date -u +%H:%M:%S))" | tee -a "$LOG"
+  timeout "$2" "${@:3}" >> "$LOG" 2>&1
+  echo "--- $1 rc=$? ($(date -u +%H:%M:%S))" | tee -a "$LOG"
+}
+
+# 1. dispatch-floor calibration + kernel block sweeps (~5 min)
+run calib 300 python tools/tpu_tune.py calib
+run flash_sweep 600 python tools/tpu_tune.py flash
+run paged_sweep 400 python tools/tpu_tune.py paged
+
+# 2. llama-650m serving on silicon — its bench failure was an opaque
+#    remote-compile 500; this isolates the real error (d=128, so NOT the
+#    lane-alignment bug that tiny hit)
+run serve_650m 900 python - <<'EOF'
+import jax, jax.numpy as jnp, numpy as np
+from deepspeedsyclsupport_tpu.inference.v2 import InferenceEngineV2
+from deepspeedsyclsupport_tpu.models import build_model
+model = build_model("llama-650m", dtype="bfloat16")
+params = model.init_params(jax.random.PRNGKey(0))
+eng = InferenceEngineV2(model, params, dtype=jnp.bfloat16,
+                        config={"block_size": 64, "max_context": 1024,
+                                "max_tokens_per_batch": 768,
+                                "max_sequences": 32,
+                                "num_blocks": 32 * 16})
+out = eng.put([1], [list(range(1, 400))])
+print("put ok", np.asarray(out[1]).shape, flush=True)
+toks = eng.generate([[1, 2, 3], [4, 5]], max_new_tokens=16)
+print("generate ok", [len(t) for t in toks], flush=True)
+EOF
+
+# 3. the full bench (driver-equivalent) — ~40 min budget
+run bench 2700 env DSTPU_BENCH_DEADLINE=2500 python bench.py
+echo "=== done $(date -u) ===" | tee -a "$LOG"
