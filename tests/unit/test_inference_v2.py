@@ -574,6 +574,43 @@ class TestMultiStepDecode:
             assert g == _naive_greedy(model, params, p, 9)
         assert not eng.seqs  # everything retired + flushed
 
+    @pytest.mark.parametrize("model_name,axes", [
+        ("tiny", dict(tp=2)),
+        ("tiny-moe", dict(ep=2)),
+    ])
+    def test_fused_decode_composes_with_parallel_serving(self, model_name,
+                                                         axes):
+        """The fused K-step while_loop runs the same auto-SPMD forward as
+        per-token decode, so it must compose with TP/EP serving topologies
+        with greedy outputs unchanged."""
+        import deepspeedsyclsupport_tpu as ds
+        from deepspeedsyclsupport_tpu.comm.topology import (
+            reset_world_topology)
+
+        prompts = [[1, 5, 9], [7, 2]]
+
+        def gen(k, topo_axes):
+            reset_world_topology()
+            topo = (ds.build_topology(dp=-1, **topo_axes)
+                    if topo_axes else None)
+            model = build_model(model_name, dtype="float32")
+            params = model.init_params()
+            eng = InferenceEngineV2(model, params, dtype=jnp.float32,
+                                    block_size=8, max_context=64,
+                                    max_tokens_per_batch=16,
+                                    max_sequences=4,
+                                    decode_steps_per_dispatch=k,
+                                    topology=topo)
+            out = eng.generate(prompts, max_new_tokens=8)
+            return [list(o) for o in out]
+
+        try:
+            want = gen(1, axes)
+            got = gen(4, axes)
+        finally:
+            reset_world_topology()
+        assert got == want, (model_name, axes, got, want)
+
     def test_dispatch_count_amortized(self, tiny):
         """K-step fusion must collapse host dispatches: 12 tokens per seq
         at K=6 needs ~prefill + ceil(12/6) dispatches, not ~13."""

@@ -3,7 +3,7 @@
 # informative first; every step appends to the log so a window that dies
 # mid-run still banks everything before it.
 set -u
-LOG=${1:-/tmp/tpu_window_$(date +%H%M).log}
+LOG=$(realpath -m "${1:-/tmp/tpu_window_$(date +%H%M).log}")
 cd "$(dirname "$0")/.."
 echo "=== tpu window $(date -u) ===" | tee -a "$LOG"
 
