@@ -260,11 +260,12 @@ class TestCausalLMPipeline:
             reset_world_topology()
         assert losses[-1] < losses[0]  # it learns through the pipeline
 
-    def test_pp2_fsdp2_parity_vs_dp(self):
-        """PP composed with ZeRO sharding (reference PP+ZeRO-1:
-        ``runtime/pipe/engine.py:55`` with ``stage_1_and_2.py``): the pipe
-        axis is manual, fsdp stays GSPMD — training losses must track a
-        plain dp-only engine on identical params and data."""
+    def test_pp_zero_and_3d_parity_vs_dp(self):
+        """PP composed with ZeRO sharding, and the full 3D composition
+        (pp x tp x fsdp — reference Megatron-DeepSpeed 3D:
+        ``runtime/pipe/engine.py:55`` + TP + ``stage_1_and_2.py``): the
+        pipe axis is manual, tp/fsdp stay GSPMD — training losses must
+        track a plain dp-only engine on identical params and data."""
         import deepspeedsyclsupport_tpu as ds
         from deepspeedsyclsupport_tpu.comm.topology import (
             build_topology, reset_world_topology)
@@ -293,6 +294,9 @@ class TestCausalLMPipeline:
         try:
             pp = run(dict(dp=2, fsdp=2, pp=2), True, 2)
             dp = run(dict(dp=4, fsdp=2), False, None)
+            # full 3D: pipe manual, tp + fsdp under GSPMD, ZeRO-1 moments
+            threed = run(dict(fsdp=2, tp=2, pp=2), True, 2)
         finally:
             reset_world_topology()
         np.testing.assert_allclose(pp, dp, rtol=5e-5)
+        np.testing.assert_allclose(threed, dp, rtol=5e-5)
