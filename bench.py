@@ -478,9 +478,19 @@ def run_train():
     # train line, so a faster variant directly moves the round's number.
     if platform == "tpu":
         name, seq, micro, steps, remat, _ = base
-        variants = ([("xla_attn", dict(attn_impl="xla")),
-                     ("noremat", dict(remat=False))] if remat
-                    else [("xla_attn", dict(attn_impl="xla"))])
+        # long context: the reference's 54% MFU bar is a LONG-SEQUENCE
+        # (Ulysses) number, and both flash and MFU improve with seq — the
+        # seq-4k rung is the apples-to-apples comparison. It runs FIRST
+        # only when tokens/step stay equal (micro/4 >= 1); on a memory-edge
+        # base (micro < 4) 4096 tokens/step would exceed the base and a
+        # likely OOM's wasted compile would eat the other variants' budget
+        variants = [("xla_attn", dict(attn_impl="xla"))]
+        if micro >= 4:
+            variants.insert(0, ("seq4k", dict(seq=4096, micro=micro // 4)))
+        else:
+            variants.append(("seq4k", dict(seq=4096, micro=1)))
+        if remat:
+            variants.append(("noremat", dict(remat=False)))
         for tag, kw in variants:
             if time.monotonic() - t_start > budget:
                 print("train variant skipped (budget)", file=sys.stderr)
@@ -490,7 +500,8 @@ def run_train():
             gc.collect()
             jax.clear_caches()
             try:
-                r = _measure(name, seq, micro, steps,
+                r = _measure(name, kw.get("seq", seq),
+                             kw.get("micro", micro), steps,
                              kw.get("remat", remat), platform,
                              attn_impl=kw.get("attn_impl", "auto"))
                 r["metric"] += f"_{tag}"  # unique metric per variant
