@@ -458,6 +458,61 @@ class TestBenchLadder:
                    for r in head["detail"]["rungs"])
         assert any("timeout" in e for e in head["detail"]["rung_errors"])
 
+    def test_midwindow_tunnel_recovery_switches_to_tpu_plan(
+            self, monkeypatch, capsys):
+        """The watcher thread finds the tunnel after the first CPU rung:
+        the main loop must switch to the TPU plan, re-running rungs that
+        only completed on CPU (done is keyed (rung, tier)) and headlining
+        a TPU line."""
+        import json as _json
+
+        import bench
+
+        class FakeWatcher:
+            def __init__(self):
+                import threading
+
+                self.attempts = [{"timeout_s": 45, "elapsed_s": 45.0,
+                                  "outcome": "probe: timeout"}]
+                self.found = threading.Event()
+
+            def probe_once(self, timeout):
+                return None          # initial probe fails
+
+            def start_background(self, deadline):
+                pass
+
+            def stop(self):
+                pass
+
+        fw = FakeWatcher()
+        seen = []
+
+        def fake_spawn(rung, timeout, env):
+            tier = "cpu" if env else "tpu"
+            seen.append((rung, tier))
+            # tunnel lands after the SECOND CPU rung ('serve'), which HAS
+            # a TPU-plan counterpart — proving the (rung, tier) done-set
+            # keying re-runs it on TPU (rung-only keying would skip it)
+            if len(seen) == 2:
+                fw.found.set()
+            return [{"metric": f"{rung}_x", "value": 1.0, "unit": "u",
+                     "vs_baseline": 0.5,
+                     "detail": {"platform": tier}}], None
+
+        monkeypatch.setattr(bench, "_spawn", fake_spawn)
+        monkeypatch.setattr(bench, "_ProbeWatcher", lambda: fw)
+        bench.main()
+        cpu_rungs = [r for r, t in seen if t == "cpu"]
+        tpu_rungs = [r for r, t in seen if t == "tpu"]
+        assert cpu_rungs == ["kernels_aot", "serve"], seen
+        # the full TPU plan ran, INCLUDING serve again on the TPU tier
+        assert tpu_rungs == [r for r, *_ in bench.TPU_PLAN], seen
+        assert ("serve", "cpu") in seen and ("serve", "tpu") in seen
+        lines = capsys.readouterr().out.strip().splitlines()
+        head = _json.loads(lines[-1])
+        assert head["detail"]["platform"] == "tpu"
+
 
 class TestSpatialAndTiling:
     """ops/spatial (diffusers fused bias-add family, reference
