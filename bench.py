@@ -42,6 +42,12 @@ def _emit(result):
     print(json.dumps(result), flush=True)
 
 
+def _attn_overrides(attn):
+    """Serving-config overrides for an explicit attention impl (the XLA
+    fallback rungs); {} keeps the registry's auto selection."""
+    return {"prefill_attn": attn, "decode_attn": attn} if attn else {}
+
+
 def _child_jax():
     """Import jax honouring a JAX_PLATFORMS override — the axon
     sitecustomize force-pins jax_platforms at interpreter start, so the env
@@ -729,9 +735,7 @@ def _serve_once(model_name, platform, *, n_clients, reqs_per_client,
     model = build_model(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
     max_seqs = max(8, 2 * n_clients)
-    extra = {}
-    if attn:  # ladder fallback: serve via the XLA impls if Mosaic trips
-        extra = {"prefill_attn": attn, "decode_attn": attn}
+    extra = _attn_overrides(attn)
     eng = InferenceEngineV2(model, params,
                             config={"max_tokens_per_batch": budget,
                                     "block_size": block_size,
@@ -830,7 +834,7 @@ def _serve_goodput_once(model_name, platform, *, client_sweep,
     model = build_model(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
     max_seqs = max(8, 2 * max(client_sweep))
-    extra = ({"prefill_attn": attn, "decode_attn": attn} if attn else {})
+    extra = _attn_overrides(attn)
     eng = InferenceEngineV2(model, params,
                             config={"max_tokens_per_batch": budget,
                                     "block_size": block_size,
@@ -905,6 +909,7 @@ def _serve_goodput_once(model_name, platform, *, client_sweep,
         "detail": {"platform": platform, "model": model_name,
                    "prompt_len": prompt_len, "gen_len": gen_len,
                    "token_budget": budget,
+                   "attn_impl": attn or "auto",
                    "sla": "per-request: TTFT <= 5x solo TTFT AND decode "
                           "rate (post-first-token) >= 50% of solo rate",
                    "best_load_point_clients": best[0],
@@ -983,7 +988,7 @@ def _serve_fused_once(model_name, platform, *, n_clients, prompt_len,
                                             size=prompt_len)]
                for _ in range(n_clients)]
 
-    extra = ({"prefill_attn": attn, "decode_attn": attn} if attn else {})
+    extra = _attn_overrides(attn)
 
     def run(k):
         eng = InferenceEngineV2(model, params,
@@ -1021,6 +1026,7 @@ def _serve_fused_once(model_name, platform, *, n_clients, prompt_len,
         "vs_baseline": round(speedup, 3),
         "detail": {"platform": platform, "model": model_name,
                    "clients": n_clients, "gen_len": gen_len,
+                   "attn_impl": attn or "auto",
                    "decode_steps_per_dispatch": fused_k,
                    "per_token_dispatch": per_tok, "fused": fused,
                    "greedy_outputs_identical": True,
@@ -1037,6 +1043,8 @@ def run_serve_fused():
     platform = jax.devices()[0].platform
     if platform == "tpu":
         ladder = [
+            dict(model_name="llama2-1b", n_clients=16, prompt_len=64,
+                 gen_len=64, block_size=64, max_context=256, fused_k=8),
             dict(model_name="llama-650m", n_clients=16, prompt_len=64,
                  gen_len=64, block_size=64, max_context=256, fused_k=8),
             # XLA fallback if the Pallas serving path trips remote Mosaic
@@ -1159,6 +1167,11 @@ def run_serve():
     platform = jax.devices()[0].platform
     if platform == "tpu":
         ladder = [
+            # the train flagship serves too: llama2-1b KV pool at 16
+            # clients is ~4.3GB + 2.6GB weights on a 16GB v5e
+            dict(model_name="llama2-1b", n_clients=16, reqs_per_client=2,
+                 prompt_len=512, gen_len=64, budget=768, block_size=64,
+                 max_context=1024),
             # 16 clients: the reference's SLA benchmark scale
             # (blogs/deepspeed-fastgen/README.md:177, Figure 5)
             dict(model_name="llama-650m", n_clients=16, reqs_per_client=2,
