@@ -552,7 +552,6 @@ def _drive_serving(eng, prompts, n_clients, reqs_per_client, gen_len, mode,
     finished = evicted = evicted_tokens = total_decoded = stall_guard = 0
     total = n_clients * reqs_per_client
     req_stats = []      # (submit_t, done_t, tokens, was_evicted) per request
-    dispatches0 = getattr(eng, "host_dispatches", 0)
 
     def submit(c, now):
         i = next_req[c]
@@ -583,6 +582,8 @@ def _drive_serving(eng, prompts, n_clients, reqs_per_client, gen_len, mode,
     float(jnp.max(warm))
     int(jnp.argmax(warm))
     eng.flush([uid_base - 1])
+    # snapshot AFTER the warmup so its dispatches stay out of the metrics
+    dispatches0 = getattr(eng, "host_dispatches", 0)
 
     t0 = time.perf_counter()
     for c in range(n_clients):

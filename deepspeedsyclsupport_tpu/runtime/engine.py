@@ -250,11 +250,15 @@ class Engine:
                 # stage_1_and_2.py cpu_offload / stage3.py:1816): each
                 # controller owns its fsdp shard's fp32 master + moments
                 t = self.config.optimizer.type.lower().replace("_", "")
-                if "nvme" in (off_opt.device, off_par.device):
+                if off_par.device == "nvme":
+                    # multi-host NVMe swap covers OPTIMIZER state (the
+                    # moments); parameter NVMe offload is single-controller
+                    # only — accepting it here would silently leave params
+                    # resident and OOM a ZeRO-Infinity-sized model
                     raise NotImplementedError(
-                        "multi-host NVMe offload not wired yet; use "
-                        "device='cpu' (per-host NVMe swap is single-"
-                        "controller only)")
+                        "multi-host offload_param device='nvme' is not "
+                        "wired; use offload_optimizer device='nvme' "
+                        "(per-host moment swap) or offload_param='cpu'")
                 if t not in ("adam", "adamw", "fusedadam", "cpuadam"):
                     raise ValueError(
                         "multi-host offload implements CPU Adam/AdamW only "
@@ -447,6 +451,20 @@ class Engine:
             if t == "adam" and not opt_params.get("adam_w_mode", True):
                 wd = 0.0
             fp16 = self.config.fp16
+            mh_swapper = None
+            if self.offload_device == "nvme":
+                # ZeRO-Infinity across controllers: each host swaps ITS
+                # moment shards to its own NVMe path (reference: every
+                # rank swaps its own partition, stage3.py:1816). Private
+                # to the optimizer — the engine's single-controller
+                # _swapper machinery keys on opt_state, which is None here
+                from .swap_tensor import AsyncTensorSwapper
+
+                nvme_path = (off_opt.nvme_path or off_par.nvme_path
+                             or os.path.join(os.getcwd(),
+                                             "dstpu_nvme_swap"))
+                mh_swapper = AsyncTensorSwapper(os.path.join(
+                    nvme_path, f"rank{jax.process_index()}"))
             self._mh_offload = MultiHostCPUAdam(
                 params, self.grad_shardings, betas=betas, eps=eps,
                 weight_decay=wd,
@@ -454,7 +472,8 @@ class Engine:
                 lr_fn=lambda step: float(np.asarray(
                     self.lr_schedule(step)
                     if callable(self.lr_schedule) else self.lr_schedule)),
-                fp16_cfg=fp16, fp16_enabled=self.fp16_enabled)
+                fp16_cfg=fp16, fp16_enabled=self.fp16_enabled,
+                swapper=mh_swapper)
             self.master_params = None
             self.opt_state = None
             self.opt_shardings = None
@@ -1185,7 +1204,9 @@ class Engine:
         scaler_sh = jax.tree_util.tree_map(lambda _: repl, self.scaler_state)
         if self._mh_offload is not None:
             mh = self._mh_offload
-            mom = mh.moments_global_tree()
+            # shape-only template — moments_global_tree() would read the
+            # whole optimizer state off NVMe just to learn shapes
+            mom = mh.moments_template_tree()
             template = {
                 "params": (mh.master_global_tree(), mh.shard_shardings),
                 "opt_state": (mom, {"m": mh.shard_shardings,

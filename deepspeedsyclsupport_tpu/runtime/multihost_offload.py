@@ -42,7 +42,7 @@ class MultiHostCPUAdam:
     def __init__(self, placed_params: Any, shard_shardings: Any, *,
                  betas: Tuple[float, float], eps: float, weight_decay: float,
                  clip: Optional[float], lr_fn: Callable[[int], float],
-                 fp16_cfg=None, fp16_enabled: bool = False):
+                 fp16_cfg=None, fp16_enabled: bool = False, swapper=None):
         self.b1, self.b2 = betas
         self.eps = eps
         self.wd = weight_decay
@@ -52,6 +52,12 @@ class MultiHostCPUAdam:
         self.fp16_enabled = fp16_enabled
         self.shard_shardings = shard_shardings
         self.step_count = 0
+        # ZeRO-Infinity across controllers: with a swapper, each host's
+        # Adam moments live on ITS NVMe between steps (the reference's
+        # per-rank optimizer-state swap, stage3.py:1816 — every rank swaps
+        # its own partition); the fp32 master stays in host RAM because
+        # the param push-back needs it every step either way.
+        self.swapper = swapper
 
         # Stage the params into the shard (ZeRO-3) layout once, on device —
         # XLA does the resharding collectives — then pull local shards.
@@ -84,9 +90,55 @@ class MultiHostCPUAdam:
             self.m.append({k: np.zeros_like(a) for k, a in shards.items()})
             self.v.append({k: np.zeros_like(a) for k, a in shards.items()})
         n_local = sum(a.nbytes for d in self.master for a in d.values())
+        # only floating leaves' moments are ever updated (the step loop
+        # skips integer leaves) — they are the only ones worth swapping,
+        # and swapping others would leak never-retrieved prefetch requests
+        self._swap_keys = [
+            {k for k, a in shards.items()
+             if np.issubdtype(a.dtype, np.floating)}
+            for shards in self.master]
+        if self.swapper is not None:
+            self._offload_moments()
         log_dist(f"multi-host offload: {len(self.master)} tensors, "
                  f"{n_local / 1e6:.1f} MB fp32 master per host, "
-                 f"{jax.process_count()} hosts")
+                 f"{jax.process_count()} hosts"
+                 + (f"; moments on NVMe ({self.swapper.swap_dir})"
+                    if self.swapper is not None else ""))
+
+    # ------------------------------------------------------------- nvme swap
+    def _offload_moments(self) -> None:
+        """Floating moments → NVMe; drop the host copies (dict KEYS are
+        kept — they are the swap names and the iteration domain)."""
+        for which, store in (("m", self.m), ("v", self.v)):
+            for li, d in enumerate(store):
+                for k in self._swap_keys[li]:
+                    if d[k] is not None:
+                        self.swapper.swap_out(f"{which}/{li}/{k}", d[k])
+                        d[k] = None
+
+    def _moment_store(self, which: str):
+        """Materialized moment shards (checkpointing); files stay valid."""
+        store = self.m if which == "m" else self.v
+        if self.swapper is None:
+            return store
+        out = []
+        for li, d in enumerate(store):
+            for k in self._swap_keys[li]:
+                self.swapper.prefetch(f"{which}/{li}/{k}")
+            out.append({k: (self.swapper.retrieve(f"{which}/{li}/{k}")
+                            if k in self._swap_keys[li] else d[k])
+                        for k in d})
+        return out
+
+    def moments_template_tree(self) -> Dict[str, Any]:
+        """Shape/dtype-faithful ZERO moments in the shard layout — the
+        checkpoint-restore template. Moments are zeros_like the master, so
+        no NVMe read is needed just to know shapes (a real-scale restore
+        must not pay a full optimizer-state disk read for a template)."""
+        zeros = [{k: np.zeros_like(a) for k, a in shards.items()}
+                 for shards in self.master]
+        return {"m": self._assemble(zeros), "v": self._assemble(zeros),
+                "step": np.asarray(self.step_count, np.int32)}
 
     # ------------------------------------------------------------------ step
     def step(self, grads: Any, scaler: LossScaleState
@@ -94,6 +146,13 @@ class MultiHostCPUAdam:
         """One partition update. ``grads``: global arrays in the shard
         layout (scaled by ``scaler.scale``). Returns (global fp32 master
         tree in shard layout, new scaler state, metrics)."""
+        if self.swapper is not None:
+            # begin the disk reads NOW — they overlap the grad-shard pull
+            # and the cross-host norm allreduce below
+            for which in ("m", "v"):
+                for li, keys in enumerate(self._swap_keys):
+                    for k in keys:
+                        self.swapper.prefetch(f"{which}/{li}/{k}")
         g_leaves = jax.tree_util.tree_leaves(grads)
         scale = float(np.asarray(jax.device_get(scaler.scale)))
         local_g: list = []
@@ -133,13 +192,18 @@ class MultiHostCPUAdam:
             lr = float(self.lr_fn(t - 1))
             bc1 = 1.0 - self.b1 ** t
             bc2 = 1.0 - self.b2 ** t
-            for p_d, m_d, v_d, g_d in zip(self.master, self.m, self.v,
-                                          local_g):
+            for li, (p_d, m_d, v_d, g_d) in enumerate(
+                    zip(self.master, self.m, self.v, local_g)):
                 for k, g in g_d.items():
                     g = g * clip_f
-                    p, m, v = p_d[k], m_d[k], v_d[k]
+                    p = p_d[k]
                     if not np.issubdtype(p.dtype, np.floating):
                         continue
+                    if self.swapper is not None:
+                        m = self.swapper.retrieve(f"m/{li}/{k}")
+                        v = self.swapper.retrieve(f"v/{li}/{k}")
+                    else:
+                        m, v = m_d[k], v_d[k]
                     m *= self.b1
                     m += (1 - self.b1) * g
                     v *= self.b2
@@ -148,6 +212,9 @@ class MultiHostCPUAdam:
                     if self.wd:
                         upd = upd + self.wd * p  # AdamW decoupled decay
                     p -= lr * upd
+                    if self.swapper is not None:
+                        self.swapper.swap_out(f"m/{li}/{k}", m)
+                        self.swapper.swap_out(f"v/{li}/{k}", v)
 
         fp16 = self.fp16_cfg
         new_scaler = update_loss_scale(
@@ -192,7 +259,8 @@ class MultiHostCPUAdam:
 
     def moments_global_tree(self) -> Dict[str, Any]:
         """Adam moments as global arrays (checkpoint payload)."""
-        return {"m": self._assemble(self.m), "v": self._assemble(self.v),
+        return {"m": self._assemble(self._moment_store("m")),
+                "v": self._assemble(self._moment_store("v")),
                 "step": np.asarray(self.step_count, np.int32)}
 
     def load_state(self, master_tree: Any, moments: Optional[Dict[str, Any]]
@@ -216,3 +284,5 @@ class MultiHostCPUAdam:
             pull(moments["m"], self.m)
             pull(moments["v"], self.v)
             self.step_count = int(np.asarray(moments["step"]))
+            if self.swapper is not None:
+                self._offload_moments()  # restored moments back to NVMe
