@@ -187,6 +187,33 @@ class _K:
         self.key = key
 
 
+class TestRingAttentionFuzz:
+    """Seeded randomized parity sweep for the zigzag causal ring (mirrors
+    test_flash_fuzz's role for the flash kernels): random half-chunk sizes,
+    GQA ratios, batch sizes, head dims, causal on/off — mask/relayout-edge
+    regressions can't hide in untested corners."""
+
+    @pytest.mark.parametrize("case", range(8))
+    def test_random_config_matches_dense(self, case):
+        rng = np.random.RandomState(10_000 + case)
+        n = 8
+        c2 = int(rng.choice([2, 4, 8]))
+        s = n * 2 * c2
+        b = int(rng.choice([1, 2]))
+        kvh = int(rng.choice([1, 2, 4]))
+        g = int(rng.choice([1, 2, 4]))
+        h, d = kvh * g, int(rng.choice([8, 16, 32]))
+        causal = bool(rng.randint(2))
+        build_topology(dp=1, sp=n)
+        q, k, v = qkv(jax.random.PRNGKey(case), b=b, s=s, h=h, kvh=kvh, d=d)
+        want = reference_attention(q, k, v, causal=causal)
+        got = jax.jit(lambda a, b_, c, ca=causal: ring_attention(
+            a, b_, c, causal=ca))(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg=str((s, b, h, kvh, d, causal)))
+
+
 class TestVocabParallelEmbedding:
     """Regression: the explicit Megatron lookup must be bit-exact against a
     plain take. The batch and the hidden dim are both fsdp-sharded, so the
