@@ -1057,20 +1057,20 @@ def run_serve_fused():
     if platform == "tpu":
         ladder = [
             dict(model_name="llama2-1b", n_clients=16, prompt_len=64,
-                 gen_len=64, block_size=64, max_context=256, fused_k=8),
+                 gen_len=64, block_size=64, max_context=256, fused_k=16),
             dict(model_name="llama-650m", n_clients=16, prompt_len=64,
-                 gen_len=64, block_size=64, max_context=256, fused_k=8),
+                 gen_len=64, block_size=64, max_context=256, fused_k=16),
             # XLA fallback if the Pallas serving path trips remote Mosaic
             dict(model_name="llama-650m", n_clients=16, prompt_len=64,
-                 gen_len=64, block_size=64, max_context=256, fused_k=8,
+                 gen_len=64, block_size=64, max_context=256, fused_k=16,
                  attn="xla"),
             dict(model_name="tiny", n_clients=16, prompt_len=64,
-                 gen_len=64, block_size=64, max_context=256, fused_k=8),
+                 gen_len=64, block_size=64, max_context=256, fused_k=16),
         ]
     else:
         ladder = [
             dict(model_name="tiny", n_clients=16, prompt_len=48,
-                 gen_len=48, block_size=16, max_context=128, fused_k=8),
+                 gen_len=48, block_size=16, max_context=128, fused_k=16),
         ]
     last_err = None
     for cfg in ladder:
