@@ -25,10 +25,12 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 
+from ..utils.fault_injection import get_fault_injector
 from ..utils.logging import log_dist, logger
 
 __all__ = ["CheckpointEngine", "NativeCheckpointEngine",
-           "AsyncCheckpointEngine", "build_checkpoint_engine"]
+           "AsyncCheckpointEngine", "build_checkpoint_engine",
+           "sweep_staging_dirs"]
 
 
 class CheckpointEngine(ABC):
@@ -38,9 +40,13 @@ class CheckpointEngine(ABC):
 
     @abstractmethod
     def save(self, path: str, state: Any, meta: Dict[str, Any],
-             latest_file: Optional[str] = None, tag: str = "") -> None:
+             latest_file: Optional[str] = None, tag: str = "",
+             post_commit: Optional[Callable[[], None]] = None) -> None:
         """Persist ``state``+``meta`` under ``path``. When ``latest_file`` is
-        given, point it at ``tag`` once the checkpoint is durable."""
+        given, point it at ``tag`` once the checkpoint is durable.
+        ``post_commit`` runs after durability is reached (for the async
+        engine: on the worker thread) — the rotation hook, which must only
+        ever observe the new tag fully on disk."""
 
     @abstractmethod
     def load(self, path: str, template: Any) -> Tuple[Any, Dict[str, Any]]:
@@ -57,9 +63,88 @@ class CheckpointEngine(ABC):
 
 
 def _write_latest(latest_file: Optional[str], tag: str) -> None:
+    """Atomically repoint ``latest``: temp file + fsync + ``os.replace``.
+    An in-place ``write()`` can be torn by a crash, leaving a pointer that
+    names no tag — after which every restart fails to resume."""
     if latest_file and jax.process_index() == 0:
-        with open(latest_file, "w") as f:
-            f.write(tag)
+        from .engine import _durable_write
+
+        _durable_write(latest_file + ".tmp", tag,
+                       what=f"latest-pointer update {latest_file}",
+                       rename_to=latest_file)
+
+
+def _run_post_commit(post_commit: Optional[Callable[[], None]]) -> None:
+    if post_commit is None:
+        return
+    try:
+        post_commit()
+    except Exception as e:  # GC must never fail a durable save
+        logger.warning("checkpoint post-commit hook failed: %s", e)
+
+
+def sweep_staging_dirs(directory: str, keep: Optional[str] = None,
+                       deep: bool = True) -> int:
+    """Clean up orphaned ``.staging-*`` dirs (a worker killed between
+    ``save_tree`` and ``os.replace`` leaves one behind). An orphan that
+    verifies complete and whose target tag is absent is *promoted* (the
+    interrupted rename is finished) — it can be the only copy of the newest
+    checkpoint when the old tag dir was already deleted to make way for it.
+    Everything else is removed. Returns the number handled.
+
+    ``deep=False`` verifies by structure + size only (no crc re-read) — for
+    callers on the training thread, where re-streaming a multi-GB orphan
+    would stall the step; same-size bit rot in a promoted tag is still
+    caught at load time and quarantined."""
+    from .engine import quarantine_tag, verify_tree
+
+    handled = 0
+    promoted = 0
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return 0
+    for name in names:
+        p = os.path.join(directory, name)
+        if not (name.startswith(".staging") and os.path.isdir(p)
+                and p != keep):
+            continue
+        target = os.path.join(directory, name[len(".staging-"):])
+        promotable = (name.startswith(".staging-") and name != ".staging-"
+                      and verify_tree(p, deep=deep)[0])
+        if promotable and os.path.exists(target) \
+                and not verify_tree(target, deep=deep)[0]:
+            # the target tag exists but is torn (a failed rmtree-then-replace
+            # left it partially deleted) while the staging copy is complete:
+            # the staging tree is the real checkpoint — move the wreck aside
+            try:
+                quarantine_tag(target)
+            except OSError as e:
+                # can't clear the way: leave the staging tree untouched (it
+                # may be the only intact copy) for a later sweep to retry
+                logger.warning("could not quarantine torn tag %s; keeping "
+                               "%s for a later sweep: %s", target, p, e)
+                continue
+        if promotable and not os.path.exists(target):
+            try:
+                os.replace(p, target)
+                logger.warning("promoted complete checkpoint staging dir "
+                               "%s -> %s", p, target)
+                handled += 1
+                promoted += 1
+                continue
+            except OSError as e:
+                logger.warning("could not promote staging dir %s: %s", p, e)
+        shutil.rmtree(p, ignore_errors=True)
+        logger.warning("swept orphaned checkpoint staging dir %s", p)
+        handled += 1
+    if handled:
+        from ..monitor.monitor import resilience_counters
+
+        resilience_counters.incr("staging_sweeps", handled - promoted)
+        if promoted:
+            resilience_counters.incr("staging_promotions", promoted)
+    return handled
 
 
 class NativeCheckpointEngine(CheckpointEngine):
@@ -68,11 +153,13 @@ class NativeCheckpointEngine(CheckpointEngine):
 
     name = "native"
 
-    def save(self, path, state, meta, latest_file=None, tag=""):
+    def save(self, path, state, meta, latest_file=None, tag="",
+             post_commit=None):
         from .engine import save_tree
 
         save_tree(path, state, meta)
         _write_latest(latest_file, tag)
+        _run_post_commit(post_commit)
 
     def load(self, path, template):
         from .engine import load_tree
@@ -91,7 +178,8 @@ class AsyncCheckpointEngine(CheckpointEngine):
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
 
-    def save(self, path, state, meta, latest_file=None, tag=""):
+    def save(self, path, state, meta, latest_file=None, tag="",
+             post_commit=None):
         from .engine import save_tree
 
         if jax.process_count() > 1:
@@ -101,8 +189,16 @@ class AsyncCheckpointEngine(CheckpointEngine):
                            "saves under multi-controller execution")
             save_tree(path, state, meta)
             _write_latest(latest_file, tag)
+            _run_post_commit(post_commit)
             return
         self.wait()  # one in-flight save; surfaces prior failures
+        # a worker killed mid-save last run (or a failed save this run) left
+        # a .staging-* orphan: sweep before staging the new one. Shallow
+        # verify — this runs on the training thread, and deep-crc'ing a
+        # multi-GB orphan here would stall the step the async engine exists
+        # to protect.
+        sweep_staging_dirs(os.path.dirname(os.path.abspath(path)),
+                           deep=False)
         # snapshot NOW, with a forced copy: the jitted train step donates
         # params/opt_state, and on the CPU backend (or host-offloaded state)
         # device_get can return a zero-copy VIEW of the donated buffer — the
@@ -118,6 +214,7 @@ class AsyncCheckpointEngine(CheckpointEngine):
 
         def work():
             try:
+                get_fault_injector().maybe_delay_async()
                 if os.path.isdir(staging):
                     shutil.rmtree(staging)
                 save_tree(staging, host_state, meta)
@@ -125,9 +222,30 @@ class AsyncCheckpointEngine(CheckpointEngine):
                     shutil.rmtree(path)
                 os.replace(staging, path)
                 _write_latest(latest_file, tag)
+                _run_post_commit(post_commit)
                 log_dist(f"async checkpoint {path} durable")
             except BaseException as e:  # surfaced on next wait()
                 self._error = e
+                from .engine import verify_tree
+
+                # `path` can be a *partially deleted* old tag dir (rmtree
+                # failed midway), so "a directory exists there" is not "a
+                # checkpoint exists there" — only a verified target makes
+                # the staging copy redundant
+                target_ok = os.path.isdir(path) and verify_tree(path)[0]
+                if os.path.isdir(staging) and not target_ok \
+                        and verify_tree(staging)[0]:
+                    # rmtree/os.replace (or later) failed after a complete
+                    # write and no healthy copy exists at the target: this
+                    # staging tree is the only copy of the checkpoint. Leave
+                    # it for the next sweep to promote instead of destroying
+                    # data.
+                    logger.warning("async save of %s failed after a complete "
+                                   "staging write; keeping %s for promotion",
+                                   path, staging)
+                else:
+                    # torn staging tree: a failed save cleans up after itself
+                    shutil.rmtree(staging, ignore_errors=True)
 
         self._thread = threading.Thread(target=work, daemon=True,
                                         name="dstpu-ckpt-writer")

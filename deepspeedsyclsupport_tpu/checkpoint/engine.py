@@ -19,16 +19,39 @@ Two backends behind one ``save_tree``/``load_tree`` surface:
 import itertools
 import json
 import os
-from typing import Any, Dict, Optional, Tuple
+import re
+import shutil
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.fault_injection import get_fault_injector, retry_io
+from ..utils.logging import logger
+
 META_FILE = "dstpu_meta.json"
 INDEX_FILE = "state_index.json"
 DATA_FILE = "state.bin"
 STATE_DIR = "state"  # orbax subdir
+LATEST_FILE = "latest"  # tag-pointer file (kept in sync with runtime/engine.py)
+INTEGRITY_KEY = "__integrity__"  # manifest section inside META_FILE
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint failed integrity verification (torn write / bit rot)."""
+
+    def __init__(self, path: str, reason: str):
+        super().__init__(f"corrupt checkpoint {path}: {reason}")
+        self.path = path
+        self.reason = reason
+
+
+def _counters():
+    from ..monitor.monitor import resilience_counters
+
+    return resilience_counters
 
 
 def _key_str(k) -> str:
@@ -61,7 +84,14 @@ def _legacy_names(name: str):
 
 
 def save_tree(path: str, state: Dict[str, Any], meta: Dict[str, Any]) -> None:
-    """Write a sharded state tree + JSON metadata under ``path``."""
+    """Write a sharded state tree + JSON metadata under ``path``.
+
+    Durability details: every file write is fsynced and wrapped in
+    :func:`~..utils.fault_injection.retry_io` so transient storage errors
+    self-heal; the meta file carries an integrity manifest (per-file size +
+    crc32, per-leaf crc32 in the index) that :func:`verify_tree` and
+    :func:`load_tree` check so a torn or bit-rotted checkpoint is detected
+    at load time instead of poisoning a resumed run."""
     path = os.path.abspath(path)
     os.makedirs(path, exist_ok=True)
     if jax.process_count() > 1:  # pragma: no cover - needs real pod
@@ -69,8 +99,60 @@ def save_tree(path: str, state: Dict[str, Any], meta: Dict[str, Any]) -> None:
     else:
         _save_native(path, state)
     if jax.process_index() == 0:
-        with open(os.path.join(path, META_FILE), "w") as f:
-            json.dump(_jsonable(meta), f, indent=2)
+        meta = dict(meta)
+        meta[INTEGRITY_KEY] = _build_manifest(path)
+        meta_path = os.path.join(path, META_FILE)
+        _durable_write(meta_path, json.dumps(_jsonable(meta), indent=2),
+                       what=f"checkpoint meta write {meta_path}")
+    # torn-write simulation happens after the save claims durability: the
+    # failure mode under test is "save completed, file is still short"
+    fi = get_fault_injector()
+    for fname in (DATA_FILE, INDEX_FILE, META_FILE):
+        p = os.path.join(path, fname)
+        if os.path.exists(p):
+            fi.maybe_truncate(p)
+
+
+def _durable_write(path: str, text: str, what: str,
+                   rename_to: Optional[str] = None) -> None:
+    """One retry unit for a small durable text file: fault-injection hook,
+    write, fsync, optional atomic rename — shared by the meta/index writers
+    and the ``latest`` pointer so their durability semantics can't drift."""
+
+    def write():
+        get_fault_injector().maybe_fail_write(rename_to or path)
+        with open(path, "w") as f:
+            f.write(text)
+            f.flush()
+            os.fsync(f.fileno())
+        if rename_to is not None:
+            os.replace(path, rename_to)
+
+    retry_io(write, what=what)
+
+
+def _file_digest(path: str) -> Dict[str, int]:
+    crc = 0
+    nbytes = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            nbytes += len(chunk)
+    return {"nbytes": nbytes, "crc32": crc}
+
+
+def _build_manifest(path: str) -> Dict[str, Any]:
+    """File-level manifest for the native layout (orbax dirs carry their own
+    per-array checksums); recorded in META_FILE, checked by verify_tree."""
+    files = {}
+    for fname in (DATA_FILE, INDEX_FILE):
+        p = os.path.join(path, fname)
+        if os.path.exists(p):
+            files[fname] = _file_digest(p)
+    return {"version": 1, "files": files}
 
 
 def load_tree(path: str, template: Dict[str, Tuple[Any, Any]]
@@ -100,18 +182,32 @@ def load_tree(path: str, template: Dict[str, Tuple[Any, Any]]
 def _save_native(path: str, state) -> None:
     leaves = jax.tree_util.tree_leaves(state)
     names = _leaf_paths(state)
-    index = []
-    offset = 0
-    with open(os.path.join(path, DATA_FILE), "wb") as f:
-        for name, leaf in zip(names, leaves):
-            arr = np.asarray(jax.device_get(leaf))
-            data = arr.tobytes()
-            index.append({"name": name, "offset": offset, "nbytes": len(data),
-                          "dtype": str(arr.dtype), "shape": list(arr.shape)})
-            f.write(data)
-            offset += len(data)
-    with open(os.path.join(path, INDEX_FILE), "w") as f:
-        json.dump(index, f)
+    data_path = os.path.join(path, DATA_FILE)
+    index_path = os.path.join(path, INDEX_FILE)
+    index: List[Dict[str, Any]] = []
+
+    def write_data():
+        # the whole file is one retry unit: "wb" re-truncates, so a retry
+        # after a partial write starts from a clean slate
+        index.clear()
+        get_fault_injector().maybe_fail_write(data_path)
+        offset = 0
+        with open(data_path, "wb") as f:
+            for name, leaf in zip(names, leaves):
+                arr = np.asarray(jax.device_get(leaf))
+                data = arr.tobytes()
+                index.append({"name": name, "offset": offset,
+                              "nbytes": len(data), "dtype": str(arr.dtype),
+                              "shape": list(arr.shape),
+                              "crc32": zlib.crc32(data)})
+                f.write(data)
+                offset += len(data)
+            f.flush()
+            os.fsync(f.fileno())
+
+    retry_io(write_data, what=f"checkpoint data write {data_path}")
+    _durable_write(index_path, json.dumps(index),
+                   what=f"checkpoint index write {index_path}")
 
 
 def _load_native(path: str, example, shardings):
@@ -138,7 +234,16 @@ def _load_native(path: str, example, shardings):
                     raise KeyError(f"checkpoint missing leaf {name!r}")
             e = by_name[name]
             f.seek(e["offset"])
-            arr = np.frombuffer(f.read(e["nbytes"]),
+            buf = f.read(e["nbytes"])
+            if len(buf) != e["nbytes"]:
+                raise CheckpointCorruptionError(
+                    path, f"leaf {name!r} torn: wanted {e['nbytes']} bytes at "
+                          f"offset {e['offset']}, file had {len(buf)}")
+            if "crc32" in e and zlib.crc32(buf) != e["crc32"]:
+                raise CheckpointCorruptionError(
+                    path, f"leaf {name!r} checksum mismatch "
+                          f"(stored {e['crc32']}, got {zlib.crc32(buf)})")
+            arr = np.frombuffer(buf,
                                 dtype=jnp.dtype(e["dtype"])).reshape(e["shape"])
             if tuple(arr.shape) != tuple(np.shape(ex)):
                 raise ValueError(
@@ -150,6 +255,12 @@ def _load_native(path: str, example, shardings):
                 # engine): cast at the boundary so the already-compiled train step
                 # sees its expected dtypes instead of recompiling or failing later.
                 arr = arr.astype(ex_dtype)
+            else:
+                # own the memory: frombuffer views the read buffer, and on the
+                # CPU backend device_put may alias host memory — which the
+                # jitted train step later DONATES. A resumed-then-trained leaf
+                # must never share storage with the I/O buffer.
+                arr = np.array(arr)
             out.append(jax.device_put(arr, sh))
     return jax.tree_util.tree_unflatten(treedef, out)
 
@@ -203,6 +314,231 @@ def _load_orbax(path: str, example, shardings):  # pragma: no cover
                              args=ocp.args.PyTreeRestore(item=item))
     finally:
         ckptr.close()
+
+
+# ------------------------------------------------------------ integrity + GC
+def verify_tree(path: str, deep: bool = True) -> Tuple[bool, str]:
+    """Offline integrity check of one checkpoint directory: meta parses, the
+    index is intact, and the data file matches the manifest. Returns
+    ``(ok, reason)`` instead of raising so callers can walk past bad tags.
+
+    ``deep=True`` re-reads every byte and checks crc32s — run before a load,
+    where a silently bit-rotted tag would poison the resumed run.
+    ``deep=False`` checks structure and file sizes only (catches torn
+    writes, skips the full re-read) — for hot paths like rotation that run
+    on every save and must not re-stream multi-GB state from storage."""
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        return False, "missing directory"
+    meta_path = os.path.join(path, META_FILE)
+    if not os.path.exists(meta_path):
+        return False, f"missing {META_FILE}"
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (ValueError, OSError) as e:
+        return False, f"unreadable {META_FILE}: {e}"
+    index_path = os.path.join(path, INDEX_FILE)
+    if not os.path.exists(index_path):
+        # orbax layout: content integrity is orbax's own (per-array
+        # checksummed) business; presence of the state dir is all we assert
+        if os.path.isdir(os.path.join(path, STATE_DIR)):
+            return True, "ok (orbax layout, content not re-verified)"
+        return False, f"missing {INDEX_FILE} and {STATE_DIR}/"
+    try:
+        with open(index_path) as f:
+            index = json.load(f)
+    except (ValueError, OSError) as e:
+        return False, f"unreadable {INDEX_FILE}: {e}"
+    data_path = os.path.join(path, DATA_FILE)
+    if not os.path.exists(data_path):
+        return False, f"missing {DATA_FILE}"
+    try:
+        expected = max((e["offset"] + e["nbytes"] for e in index), default=0)
+        size = os.path.getsize(data_path)
+        if size < expected:
+            return False, (f"torn {DATA_FILE}: {size} bytes on disk, index "
+                           f"expects {expected}")
+        manifest = meta.get(INTEGRITY_KEY)
+        if manifest:
+            for fname, want in manifest.get("files", {}).items():
+                p = os.path.join(path, fname)
+                if not os.path.exists(p):
+                    return False, f"missing {fname}"
+                if not deep:
+                    size = os.path.getsize(p)
+                    if size != want.get("nbytes"):
+                        return False, (f"{fname} size mismatch: manifest "
+                                       f"says {want.get('nbytes')}, on disk "
+                                       f"{size}")
+                    continue
+                got = _file_digest(p)
+                if got != want:
+                    return False, (f"{fname} manifest mismatch: stored "
+                                   f"{want}, on disk {got}")
+        elif deep:
+            # pre-manifest checkpoint: fall back to per-leaf crcs if present
+            with open(data_path, "rb") as f:
+                for e in index:
+                    if "crc32" not in e:
+                        continue
+                    f.seek(e["offset"])
+                    if zlib.crc32(f.read(e["nbytes"])) != e["crc32"]:
+                        return False, (f"leaf {e['name']!r} checksum "
+                                       f"mismatch")
+    except (KeyError, TypeError, ValueError, AttributeError, OSError) as e:
+        # valid JSON whose entries are damaged (bit rot inside the index or
+        # manifest), or a file racing out from under us: that is corruption,
+        # never an exception — the fallback walk depends on this function
+        # answering, not raising
+        return False, f"malformed index/manifest: {e!r}"
+    return True, "ok"
+
+
+def _read_latest(load_dir: str) -> Optional[str]:
+    latest = os.path.join(load_dir, LATEST_FILE)
+    if not os.path.exists(latest):
+        return None
+    with open(latest) as f:
+        tag = f.read().strip()
+    return tag or None
+
+
+def list_tags(load_dir: str) -> List[str]:
+    """Checkpoint tags under ``load_dir``, newest first (by recorded
+    ``global_steps``, then mtime — mtime alone lies after restores/copies)."""
+    out = []
+    try:
+        names = os.listdir(load_dir)
+    except OSError:
+        return []
+    for name in names:
+        p = os.path.join(load_dir, name)
+        if not os.path.isdir(p) or name.startswith(".staging") \
+                or _QUARANTINE_RE.search(name):
+            continue
+        if not (os.path.exists(os.path.join(p, META_FILE))
+                or os.path.exists(os.path.join(p, INDEX_FILE))
+                or os.path.isdir(os.path.join(p, STATE_DIR))):
+            continue
+        steps = -1
+        try:
+            with open(os.path.join(p, META_FILE)) as f:
+                steps = int(json.load(f).get("global_steps", -1))
+        except (OSError, ValueError, TypeError):
+            pass  # torn meta: still a candidate, ranked by mtime only
+        out.append((steps, os.path.getmtime(p), name))
+    out.sort(reverse=True)
+    return [name for _, _, name in out]
+
+
+def _candidate_tags(load_dir: str) -> Tuple[Optional[str], List[str]]:
+    """The one candidate ordering every fallback walk shares: whatever
+    ``latest`` points at first, then the remaining tags newest-first."""
+    pointed = _read_latest(load_dir)
+    candidates = [pointed] if pointed is not None else []
+    candidates.extend(t for t in list_tags(load_dir) if t != pointed)
+    return pointed, candidates
+
+
+# names produced by quarantine_tag: <tag>.corrupt, <tag>.corrupt.1, ... —
+# list_tags must skip every generation or a quarantined tag re-enters the
+# candidate walk on the next restart
+_QUARANTINE_RE = re.compile(r"\.corrupt(\.\d+)?$")
+
+
+def quarantine_tag(path: str) -> str:
+    """Rename a corrupt tag out of the candidate walk, keeping it on disk as
+    forensic evidence. The destination is uniquified — the same tag name can
+    be re-saved and re-corrupted across restarts, and ``os.replace`` onto an
+    existing non-empty ``.corrupt`` directory raises ENOTEMPTY."""
+    dst = path + ".corrupt"
+    n = 1
+    while os.path.exists(dst):
+        dst = f"{path}.corrupt.{n}"
+        n += 1
+    os.replace(path, dst)
+    return dst
+
+
+def find_latest_valid_tag(load_dir: str, deep: bool = True
+                          ) -> Tuple[Optional[str], List[Tuple[str, str]]]:
+    """Newest tag that passes :func:`verify_tree`, walking tag history
+    backwards past corrupt/torn tags. The ``latest`` pointer is tried first;
+    returns ``(tag_or_None, [(skipped_tag, reason), ...])``. ``deep=False``
+    skips the crc re-read — right when the caller is about to stream the
+    tag anyway (the loader checks per-leaf crc32s itself)."""
+    skipped: List[Tuple[str, str]] = []
+    _, candidates = _candidate_tags(load_dir)
+    for tag in candidates:
+        ok, reason = verify_tree(os.path.join(load_dir, tag), deep=deep)
+        if ok:
+            return tag, skipped
+        skipped.append((tag, reason))
+    return None, skipped
+
+
+def load_latest_valid(load_dir: str, template: Dict[str, Tuple[Any, Any]]
+                      ) -> Tuple[Optional[str], Any, Dict[str, Any]]:
+    """Load the newest *verified* checkpoint under ``load_dir``, falling back
+    through tag history on corruption instead of crashing — a torn newest
+    tag costs one save interval, not the run. Returns
+    ``(tag, state, meta)``; ``(None, None, {})`` when nothing loadable.
+
+    Candidates are shallow-verified only: ``load_tree`` re-checks every
+    leaf's crc32 during the read anyway (raising
+    ``CheckpointCorruptionError``, handled below by quarantine + continue),
+    so a deep pre-verify would stream each candidate twice."""
+    counters = _counters()
+    pointed, candidates = _candidate_tags(load_dir)
+    skipped_any = False
+    for tag in candidates:
+        path = os.path.join(load_dir, tag)
+        ok, reason = verify_tree(path, deep=False)
+        if not ok:
+            logger.warning("skipping corrupt checkpoint %s: %s", path, reason)
+            counters.incr("corrupt_tags_skipped")
+            skipped_any = True
+            continue
+        try:
+            state, meta = load_tree(path, template)
+        except CheckpointCorruptionError as e:
+            # verified-then-torn race (or unverifiable orbax content):
+            # quarantine by renaming so later walks skip it too
+            logger.warning("checkpoint %s corrupt on read (%s); quarantining",
+                           path, e.reason)
+            counters.incr("corrupt_tags_skipped")
+            skipped_any = True
+            quarantine_tag(path)
+            continue
+        if tag != pointed or skipped_any:
+            counters.incr("fallback_loads")
+            logger.warning("fallback load: resumed %s (latest pointer was "
+                           "%r)", path, pointed)
+        return tag, state, meta
+    return None, None, {}
+
+
+def rotate_checkpoints(save_dir: str, keep_last_n: int) -> List[str]:
+    """Garbage-collect old tags, keeping the newest ``keep_last_n``
+    *verified* checkpoints. Only ever deletes a verified checkpoint older
+    than the newest verified one — corrupt/unverifiable tags are left in
+    place (they are forensic evidence, and deleting them can never free the
+    rollback target). Returns the deleted tags."""
+    if keep_last_n < 1:
+        raise ValueError(f"keep_last_n must be >= 1, got {keep_last_n}")
+    pointed = _read_latest(save_dir)
+    # shallow verify: rotation runs after every save, and a deep (full-CRC)
+    # pass would re-stream every retained tag's bytes from storage each time
+    verified = [t for t in list_tags(save_dir)
+                if verify_tree(os.path.join(save_dir, t), deep=False)[0]]
+    doomed = [t for t in verified[keep_last_n:] if t != pointed]
+    for tag in doomed:
+        shutil.rmtree(os.path.join(save_dir, tag), ignore_errors=True)
+        logger.info("rotated out checkpoint %s", os.path.join(save_dir, tag))
+    if doomed:
+        _counters().incr("checkpoints_rotated", len(doomed))
+    return doomed
 
 
 def _jsonable(obj):

@@ -17,34 +17,74 @@ elastic event is a process-tree restart with a recomputed world:
    resume work; reference needs universal checkpoints for this).
 """
 import os
+import random
 import subprocess
 import sys
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from .elasticity import ElasticityError, compute_elastic_config
+from ..runtime.resilience import PREEMPTION_EXIT_CODE
 from ..utils.logging import logger
 
 
 class DSElasticAgent:
-    """Supervise an elastic training command (reference ``DSElasticAgent``)."""
+    """Supervise an elastic training command (reference ``DSElasticAgent``).
+
+    Restart accounting distinguishes two exit classes:
+
+    * ``PREEMPTION_EXIT_CODE`` — the worker caught SIGTERM, wrote an emergency
+      checkpoint and exited cleanly. The restart is *free* (a preempted VM is
+      fleet weather, not a crash loop) and relaunch is immediate.
+    * any other non-zero rc — a real failure: counted against
+      ``restart_limit`` and backed off exponentially
+      (``backoff_seconds * 2^failures`` + jitter, capped at
+      ``backoff_ceiling``) so a hard crash loop cannot hammer the cluster
+      scheduler or a shared filesystem.
+    """
 
     def __init__(self, cmd: Sequence[str], ds_config: Dict[str, Any],
                  min_nodes: int = 1, max_nodes: int = -1,
                  restart_limit: int = 3,
                  backoff_seconds: float = 0.0,
+                 backoff_ceiling: float = 60.0,
+                 backoff_jitter: float = 0.25,
+                 backoff_seed: Optional[int] = None,
+                 preemption_limit: Optional[int] = None,
                  env: Optional[Dict[str, str]] = None,
-                 hostfile: Optional[str] = None):
+                 hostfile: Optional[str] = None,
+                 sleep_fn: Optional[Callable[[float], None]] = None):
         self.cmd = list(cmd)
         self.ds_config = ds_config
         self.min_nodes = min_nodes
         self.max_nodes = max_nodes
         self.restart_limit = restart_limit
         self.backoff_seconds = backoff_seconds
+        self.backoff_ceiling = backoff_ceiling
+        self.backoff_jitter = backoff_jitter
+        # consecutive preemptions before the agent gives up and returns the
+        # preemption rc (None = unbounded): a fleet-wide drain that SIGTERMs
+        # every relaunch would otherwise loop forever
+        self.preemption_limit = preemption_limit
+        # seedable jitter so the fault-injection suite replays identically
+        self._rng = random.Random(backoff_seed)
+        self._sleep = sleep_fn or time.sleep
         self.extra_env = dict(env or {})
         self.hostfile = hostfile
-        self.restart_count = 0
+        self.restart_count = 0  # failures only — preemptions are free
+        self.preemption_count = 0
         self.launch_history: List[Dict[str, Any]] = []
+
+    def next_backoff(self, consecutive_failures: int) -> float:
+        """Capped exponential backoff + jitter for the Nth consecutive
+        failure (1-based). Jitter is multiplicative in
+        ``[1, 1 + backoff_jitter]`` — always *added* so the cap stays a true
+        ceiling on the base and concurrent agents still de-synchronize."""
+        if self.backoff_seconds <= 0:
+            return 0.0
+        base = min(self.backoff_ceiling,
+                   self.backoff_seconds * (2 ** max(0, consecutive_failures - 1)))
+        return base * (1.0 + self.backoff_jitter * self._rng.random())
 
     # ------------------------------------------------------------ membership
     def discover_world_size(self) -> int:
@@ -74,8 +114,16 @@ class DSElasticAgent:
 
     # ------------------------------------------------------------------ run
     def run(self) -> int:
-        """Launch; restart on failure up to ``restart_limit`` times. Returns
-        the final exit code (0 on success)."""
+        """Launch; restart on failure up to ``restart_limit`` times. A
+        ``PREEMPTION_EXIT_CODE`` exit restarts for free (the worker saved an
+        emergency checkpoint on SIGTERM — see ``runtime/resilience.py``) and
+        resets the failure backoff; any other non-zero rc counts against the
+        limit and backs off exponentially. Returns the final exit code
+        (0 on success)."""
+        from ..monitor.monitor import resilience_counters
+
+        consecutive_failures = 0
+        consecutive_preemptions = 0
         while True:
             world = self.discover_world_size()
             if world < self.min_nodes:
@@ -87,26 +135,56 @@ class DSElasticAgent:
             env.update(self.extra_env)
             env.update(self._resolve(world))
             env["DSTPU_ELASTIC_RESTART_COUNT"] = str(self.restart_count)
+            env["DSTPU_ELASTIC_PREEMPTION_COUNT"] = str(self.preemption_count)
             env["DSTPU_ELASTIC_WORLD_SIZE"] = str(world)
             logger.info("elastic agent: launching (attempt %d, world=%d)",
-                        self.restart_count + 1, world)
+                        self.restart_count + self.preemption_count + 1, world)
             proc = subprocess.run(self.cmd, env=env)
             self.launch_history.append(
                 {"world": world, "rc": proc.returncode,
-                 "restart": self.restart_count})
+                 "restart": self.restart_count,
+                 "preempted": proc.returncode == PREEMPTION_EXIT_CODE})
             if proc.returncode == 0:
                 return 0
+            resilience_counters.incr("restarts")
+            if proc.returncode == PREEMPTION_EXIT_CODE:
+                # clean preemption: durable emergency checkpoint exists, the
+                # eviction wasn't the worker's fault — the restart is free,
+                # but not a hot loop: a fleet-wide drain SIGTERMs every
+                # relaunch seconds after startup, so pace relaunches at the
+                # jittered base backoff and bound the streak
+                self.preemption_count += 1
+                consecutive_preemptions += 1
+                consecutive_failures = 0
+                if self.preemption_limit is not None \
+                        and consecutive_preemptions > self.preemption_limit:
+                    logger.error("elastic agent: %d consecutive preemptions "
+                                 "exceeds limit %d — giving up",
+                                 consecutive_preemptions,
+                                 self.preemption_limit)
+                    return proc.returncode
+                logger.warning("elastic agent: worker preempted (rc=%d, "
+                               "preemption #%d) — restarting without "
+                               "consuming restart budget",
+                               proc.returncode, self.preemption_count)
+                delay = self.next_backoff(1)  # base only: no failure streak
+                if delay > 0:
+                    self._sleep(delay)
+                continue
             self.restart_count += 1
+            consecutive_failures += 1
+            consecutive_preemptions = 0
             if self.restart_count > self.restart_limit:
                 logger.error("elastic agent: restart limit %d exhausted "
                              "(last rc=%d)", self.restart_limit,
                              proc.returncode)
                 return proc.returncode
+            delay = self.next_backoff(consecutive_failures)
             logger.warning("elastic agent: worker failed rc=%d — "
-                           "re-discovering membership and restarting",
-                           proc.returncode)
-            if self.backoff_seconds:
-                time.sleep(self.backoff_seconds)
+                           "re-discovering membership and restarting "
+                           "in %.2fs", proc.returncode, delay)
+            if delay > 0:
+                self._sleep(delay)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -120,6 +198,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--restart-limit", type=int, default=3)
     ap.add_argument("--min-nodes", type=int, default=1)
     ap.add_argument("--max-nodes", type=int, default=-1)
+    ap.add_argument("--backoff-seconds", type=float, default=1.0,
+                    help="base delay after a failure; doubles per consecutive "
+                         "failure up to --backoff-ceiling, plus jitter")
+    ap.add_argument("--backoff-ceiling", type=float, default=60.0)
+    ap.add_argument("--preemption-limit", type=int, default=None,
+                    help="consecutive preemption exits before the agent "
+                         "gives up (default: unbounded)")
     ap.add_argument("--hostfile", default=None)
     ap.add_argument("cmd", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
@@ -129,6 +214,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     agent = DSElasticAgent(cmd, ds_config, min_nodes=args.min_nodes,
                            max_nodes=args.max_nodes,
                            restart_limit=args.restart_limit,
+                           backoff_seconds=args.backoff_seconds,
+                           backoff_ceiling=args.backoff_ceiling,
+                           preemption_limit=args.preemption_limit,
                            hostfile=args.hostfile)
     return agent.run()
 

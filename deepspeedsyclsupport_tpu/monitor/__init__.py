@@ -1,1 +1,2 @@
-from .monitor import CsvMonitor, Monitor, MonitorMaster, TensorBoardMonitor, WandbMonitor
+from .monitor import (CsvMonitor, Monitor, MonitorMaster, ResilienceCounters,
+                      TensorBoardMonitor, WandbMonitor, resilience_counters)

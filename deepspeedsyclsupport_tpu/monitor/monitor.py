@@ -6,16 +6,56 @@ Same event contract: ``write_events([(name, value, global_step), ...])``.
 """
 import csv
 import os
-from typing import Any, List, Optional, Tuple
+import threading
+from typing import TYPE_CHECKING, Any, Dict, List, Optional, Tuple
 
-from ..runtime.config import MonitorConfig
 from ..utils.logging import logger
+
+if TYPE_CHECKING:  # import-time would cycle: runtime/__init__ -> engine ->
+    from ..runtime.config import MonitorConfig  # monitor -> runtime.config
 
 Event = Tuple[str, Any, int]
 
 
+class ResilienceCounters:
+    """Process-wide degradation counters (ISSUE: operators must *see* retries,
+    fallback loads, emergency saves and restarts instead of discovering them
+    at recovery time). Incremented by the checkpoint writers, the preemption
+    handler and the elastic agent; the engine surfaces changed counters as
+    ``Resilience/*`` monitor events at its print boundaries."""
+
+    NAMES = ("io_retries", "io_giveups", "corrupt_tags_skipped",
+             "fallback_loads", "emergency_saves", "preemptions",
+             "staging_sweeps", "staging_promotions", "checkpoints_rotated",
+             "restarts")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = dict.fromkeys(self.NAMES, 0)
+
+    def incr(self, name: str, n: int = 1) -> int:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+            return self._counts[name]
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = dict.fromkeys(self.NAMES, 0)
+
+
+resilience_counters = ResilienceCounters()
+
+
 class Monitor:
-    def __init__(self, config: MonitorConfig):
+    def __init__(self, config: "MonitorConfig"):
         self.config = config
         self.enabled = True
 
@@ -32,7 +72,7 @@ class Monitor:
 class CsvMonitor(Monitor):
     """CSV backend (reference: ``monitor/csv_monitor.py``): one file per metric."""
 
-    def __init__(self, config: MonitorConfig):
+    def __init__(self, config: "MonitorConfig"):
         super().__init__(config)
         self.base = os.path.join(config.csv_output_path or "csv_logs",
                                  config.csv_job_name)
@@ -65,7 +105,7 @@ class TensorBoardMonitor(Monitor):
     """TensorBoard backend (reference: ``monitor/tensorboard.py``); degrades to a
     warning when no tensorboard writer is importable in the image."""
 
-    def __init__(self, config: MonitorConfig):
+    def __init__(self, config: "MonitorConfig"):
         super().__init__(config)
         self.writer = None
         path = os.path.join(config.tensorboard_output_path or "tensorboard",
@@ -96,7 +136,7 @@ class TensorBoardMonitor(Monitor):
 class WandbMonitor(Monitor):
     """Weights & Biases backend (reference: ``monitor/wandb.py``); gated on import."""
 
-    def __init__(self, config: MonitorConfig):
+    def __init__(self, config: "MonitorConfig"):
         super().__init__(config)
         try:
             import wandb  # type: ignore
@@ -120,7 +160,7 @@ class MonitorMaster(Monitor):
     """Fan-out to all enabled backends; only process rank 0 writes (reference:
     ``monitor/monitor.py`` MonitorMaster rank gating)."""
 
-    def __init__(self, config: MonitorConfig):
+    def __init__(self, config: "MonitorConfig"):
         super().__init__(config)
         import jax
 

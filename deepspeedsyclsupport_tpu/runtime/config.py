@@ -344,6 +344,10 @@ class CheckpointConfig:
     # Nebula-analog engine is opt-in via async_save or engine="async"
     async_save: bool = False
     engine: str = "native"  # native | async (checkpoint/ckpt_engine.py)
+    # rotation: keep the newest N *verified* checkpoints, GC older ones after
+    # each durable save (checkpoint/engine.py::rotate_checkpoints). 0 = never
+    # delete anything (the default — rotation is opt-in).
+    keep_last_n: int = 0
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "CheckpointConfig":
@@ -360,10 +364,15 @@ class CheckpointConfig:
                 f"contradictory checkpoint config: engine={engine!r} with "
                 f"async_save={async_save}")
         async_save = engine == "async"  # keep the two views consistent
+        keep_last_n = int(d.get("keep_last_n", 0))
+        if keep_last_n < 0:
+            raise ValueError(
+                f"checkpoint.keep_last_n must be >= 0, got {keep_last_n}")
         return cls(tag_validation=tv,
                    use_node_local_storage=bool(d.get("use_node_local_storage", False)),
                    load_universal=bool(d.get("load_universal", False)),
-                   async_save=async_save, engine=engine)
+                   async_save=async_save, engine=engine,
+                   keep_last_n=keep_last_n)
 
 
 @dataclass

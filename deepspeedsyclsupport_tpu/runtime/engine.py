@@ -39,6 +39,7 @@ from .loss_scaler import (LossScaleState, grads_finite, init_loss_scale, scale_l
                           unscale_grads, update_loss_scale)
 from .lr_schedules import build_schedule
 from .optimizers import build_optimizer, current_lr
+from ..checkpoint.engine import LATEST_FILE
 from ..comm.comms_logging import comms_logger
 from ..comm.topology import MeshTopology, build_topology
 from ..monitor import MonitorMaster
@@ -46,8 +47,6 @@ from ..utils.logging import log_dist, logger
 from ..utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER,
                            STEP_GLOBAL_TIMER, SynchronizedWallClockTimer,
                            ThroughputTimer)
-
-LATEST_FILE = "latest"  # tag-pointer file name (reference: engine.py save_checkpoint)
 
 
 class _InitTuple(NamedTuple):
@@ -304,6 +303,8 @@ class Engine:
         self._accum_count = 0
         self._accum_losses = []
         self._pending_events = []  # buffered monitor samples (see _post_step)
+        self._resilience = None  # ResilienceManager (enable_preemption_handling)
+        self._resilience_reported = {}  # last counter values flushed to monitor
         self._last_batch = None
         self._rng = jax.random.PRNGKey(self.config.seed)
         self.timers = SynchronizedWallClockTimer()
@@ -1080,6 +1081,10 @@ class Engine:
                 self.global_steps % self.config.steps_per_print == 0:
             self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
                              STEP_GLOBAL_TIMER])
+        if self._resilience is not None:
+            # step boundary: the only point where every buffer is quiescent,
+            # so a pending SIGTERM (or injected preemption) saves here
+            self._resilience.at_step_boundary()
 
     def _flush_monitor(self):
         events = []
@@ -1091,6 +1096,16 @@ class Engine:
                     val = self.get_lr()
             events.append((name, float(jax.device_get(val)), samples))
         self._pending_events = []
+        # degradation visibility: surface changed resilience counters (I/O
+        # retries, fallback loads, emergency saves, …) as monitor events so
+        # operators see trouble brewing instead of discovering it at recovery
+        from ..monitor.monitor import resilience_counters
+
+        samples = self.global_steps * self.config.train_batch_size
+        for name, value in resilience_counters.snapshot().items():
+            if value and value != self._resilience_reported.get(name):
+                self._resilience_reported[name] = value
+                events.append((f"Resilience/{name}", value, samples))
         if events:
             self.monitor.write_events(events)
 
@@ -1124,6 +1139,24 @@ class Engine:
 
     def train_batch_size(self) -> int:
         return self.config.train_batch_size
+
+    # ================================================================ resilience
+    def enable_preemption_handling(self, save_dir: str,
+                                   install_signal_handlers: bool = True,
+                                   exit_fn: Optional[Callable[[int], None]]
+                                   = None):
+        """Arm preemption-aware checkpointing: SIGTERM/SIGINT (or an injected
+        ``preempt_at_step`` fault) triggers an emergency ``save_checkpoint``
+        into ``save_dir`` at the next step boundary, then exits with
+        ``resilience.PREEMPTION_EXIT_CODE`` — which the elastic agent treats
+        as a free restart. Returns the installed
+        :class:`~.resilience.ResilienceManager`."""
+        from .resilience import ResilienceManager
+
+        self._resilience = ResilienceManager(self, save_dir, exit_fn=exit_fn)
+        if install_signal_handlers:
+            self._resilience.install()
+        return self._resilience
 
     # ================================================================ checkpoint
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
@@ -1162,11 +1195,19 @@ class Engine:
             meta["random_ltd"] = self.random_ltd_scheduler.state_dict()
         if self.qat_scheduler is not None:
             meta["qat"] = self.qat_scheduler.state_dict()
+        post_commit = None
+        keep = self.config.checkpoint.keep_last_n
+        if keep and jax.process_index() == 0:
+            from ..checkpoint.engine import rotate_checkpoints
+
+            # rotation rides the engine's post-commit hook so it only ever
+            # runs once the new tag is durable (async: on the worker thread)
+            post_commit = lambda: rotate_checkpoints(save_dir, keep)  # noqa: E731
         self.checkpoint_engine.save(
             path, state, meta,
             latest_file=(os.path.join(save_dir, LATEST_FILE)
                          if save_latest else None),
-            tag=tag)
+            tag=tag, post_commit=post_commit)
         if self._swapper is not None:
             self._swap_out_opt_state()
         log_dist(f"saved checkpoint {path} "
@@ -1179,17 +1220,49 @@ class Engine:
         """Restore (reference ``engine.load_checkpoint:2688``). Resharding-on-load:
         orbax restores into the *current* shardings, so a checkpoint written on any
         topology loads on any other — the capability the reference needs universal
-        checkpoints for."""
+        checkpoints for.
+
+        A tag that passes :func:`~..checkpoint.engine.verify_tree` but tears
+        between verification and read (raising
+        ``CheckpointCorruptionError``) is quarantined and resolution retried
+        on the remaining history — the engine path recovers from the same
+        verified-then-torn race :func:`~..checkpoint.engine.load_latest_valid`
+        does. An explicitly requested ``tag`` is never walked past: its
+        corruption propagates to the caller."""
+        from ..checkpoint.engine import (CheckpointCorruptionError,
+                                         quarantine_tag)
+
+        while True:
+            try:
+                return self._load_checkpoint_once(load_dir, tag,
+                                                  load_optimizer_states)
+            except CheckpointCorruptionError as e:
+                if tag is not None:
+                    raise
+                from ..monitor.monitor import resilience_counters
+
+                logger.warning("checkpoint %s corrupt on read (%s); "
+                               "quarantining and retrying resolution",
+                               e.path, e.reason)
+                resilience_counters.incr("corrupt_tags_skipped")
+                quarantine_tag(e.path)
+
+    def _load_checkpoint_once(self, load_dir: str, tag: Optional[str],
+                              load_optimizer_states: bool
+                              ) -> Tuple[Optional[str], Dict]:
         load_tree = self.checkpoint_engine.load
         # before resolving `latest`: an async save may still be writing it
         self.checkpoint_engine.wait()
+        if jax.process_index() == 0:
+            # a worker killed mid-save before this restart left .staging-*
+            # orphans behind; resume is the natural sweep point
+            from ..checkpoint.ckpt_engine import sweep_staging_dirs
+
+            sweep_staging_dirs(load_dir)
         if tag is None:
-            latest = os.path.join(load_dir, LATEST_FILE)
-            if not os.path.exists(latest):
-                logger.warning("no 'latest' file in %s; nothing loaded", load_dir)
+            tag = self._resolve_resume_tag(load_dir)
+            if tag is None:
                 return None, {}
-            with open(latest) as f:
-                tag = f.read().strip()
         path = os.path.join(load_dir, tag)
         if glob_mod.glob(os.path.join(path, "mp_rank_*_model_states.pt")):
             # a REFERENCE-format checkpoint (torch .pt layout): route to the
@@ -1263,6 +1336,41 @@ class Engine:
         # skipped_steps rides in scaler_state.overflows, restored above
         log_dist(f"loaded checkpoint {path}")
         return path, meta.get("client_state", {})
+
+    def _resolve_resume_tag(self, load_dir: str) -> Optional[str]:
+        """Which tag to resume from: whatever ``latest`` names if it
+        verifies, else the newest tag in history that does — a torn newest
+        checkpoint costs one save interval, not the run. ``None`` when the
+        directory holds nothing loadable.
+
+        Shallow verification only (meta/index parse + file sizes): the
+        chosen tag is immediately read by ``load_tree``, which checks every
+        leaf's crc32 and raises ``CheckpointCorruptionError`` on mismatch —
+        deep-verifying here would stream a multi-GB checkpoint twice on the
+        restart critical path."""
+        from ..checkpoint.engine import _read_latest, find_latest_valid_tag
+        from ..monitor.monitor import resilience_counters
+
+        pointed = _read_latest(load_dir)
+        if pointed is not None and glob_mod.glob(
+                os.path.join(load_dir, pointed, "mp_rank_*_model_states.pt")):
+            # a REFERENCE-format (torch .pt layout) checkpoint carries no
+            # dstpu manifest to verify; hand it to the importer untouched
+            return pointed
+        tag, skipped = find_latest_valid_tag(load_dir, deep=False)
+        for skipped_tag, reason in skipped:
+            logger.warning("skipping corrupt checkpoint %s: %s",
+                           os.path.join(load_dir, skipped_tag), reason)
+            resilience_counters.incr("corrupt_tags_skipped")
+        if tag is None:
+            logger.warning("no loadable checkpoint in %s; nothing loaded",
+                           load_dir)
+            return None
+        if tag != pointed or skipped:
+            resilience_counters.incr("fallback_loads")
+            logger.warning("fallback load: resuming %s (latest pointer was "
+                           "%r)", os.path.join(load_dir, tag), pointed)
+        return tag
 
     def save_16bit_model(self, save_dir: str,
                          checkpoint_name: str = "mp_rank_00_model_states.pt"

@@ -1,0 +1,188 @@
+"""Deterministic fault injection for resilience testing.
+
+TPU fleet reality (arXiv 2011.03641's failure-domain analysis): VMs get
+maintenance-evicted mid-step, NFS/GCS writes fail transiently, and async
+writers can be arbitrarily delayed — so every one of those failure modes
+needs a *deterministic* lever the test suite can pull. A single process-wide
+:class:`FaultInjector` exposes injection points that the checkpoint writers
+(``checkpoint/engine.py``), the async engine
+(``checkpoint/ckpt_engine.py::AsyncCheckpointEngine``) and the preemption
+handler (``runtime/resilience.py``) consult. All state is counter-based —
+no wall-clock or RNG — so a given spec replays identically.
+
+Spec (programmatic dict or JSON in the ``DSTPU_FAULT_INJECTION`` env var):
+
+``{"write_fail":  {"match": "state.bin", "count": 2},``
+``  "truncate":   {"match": "state.bin", "keep_bytes": 64, "count": 1},``
+``  "async_delay": 0.05,``
+``  "preempt_at_step": 3}``
+
+* ``write_fail`` — the next ``count`` storage writes whose target path
+  contains ``match`` raise a transient :class:`OSError` (``EIO``) before any
+  bytes hit disk. Paired with :func:`retry_io` this exercises the
+  self-healing path.
+* ``truncate`` — after a matching file is durably written, chop it to
+  ``keep_bytes`` (or ``keep_fraction`` of its size): a torn write, exactly
+  what a preemption mid-``write(2)`` leaves behind.
+* ``async_delay`` — seconds the async checkpoint worker sleeps before
+  touching storage, widening the save/shutdown race window.
+* ``preempt_at_step`` — deliver one simulated preemption request at the
+  first step boundary where ``global_steps >= N`` (consumed by
+  ``runtime/resilience.py``), standing in for a real SIGTERM.
+"""
+import errno
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, TypeVar
+
+from .logging import logger
+
+ENV_SPEC = "DSTPU_FAULT_INJECTION"
+
+T = TypeVar("T")
+
+
+class InjectedOSError(OSError):
+    """Marker subclass so logs/tests can tell injected faults from real ones."""
+
+
+class FaultInjector:
+    """Counter-based fault delivery; thread-safe (the async checkpoint worker
+    and the training thread both consult it)."""
+
+    def __init__(self, spec: Optional[Dict[str, Any]] = None):
+        spec = dict(spec or {})
+        self.write_fail = dict(spec.get("write_fail") or {})
+        self.truncate = dict(spec.get("truncate") or {})
+        self.async_delay = float(spec.get("async_delay") or 0.0)
+        p = spec.get("preempt_at_step")
+        self.preempt_at_step: Optional[int] = None if p is None else int(p)
+        self._write_failures_left = int(self.write_fail.get("count", 0))
+        self._truncates_left = int(self.truncate.get("count", 1)
+                                   if self.truncate else 0)
+        self._preempted = False
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls) -> "FaultInjector":
+        raw = os.environ.get(ENV_SPEC)
+        if not raw:
+            return cls()
+        try:
+            return cls(json.loads(raw))
+        except ValueError as e:
+            raise ValueError(f"{ENV_SPEC} is not valid JSON: {e}") from e
+
+    @property
+    def armed(self) -> bool:
+        return bool(self.write_fail or self.truncate or self.async_delay
+                    or self.preempt_at_step is not None)
+
+    # ------------------------------------------------------- injection points
+    @staticmethod
+    def _matches(pattern: Optional[str], path: str) -> bool:
+        return pattern is None or pattern in path
+
+    def maybe_fail_write(self, path: str) -> None:
+        """Raise a transient ``OSError`` for the next N matching writes."""
+        with self._lock:
+            if self._write_failures_left <= 0:
+                return
+            if not self._matches(self.write_fail.get("match"), path):
+                return
+            self._write_failures_left -= 1
+        raise InjectedOSError(errno.EIO,
+                              f"injected transient write failure for {path}")
+
+    def maybe_truncate(self, path: str) -> bool:
+        """Tear a durably-written file; returns True if it was truncated."""
+        with self._lock:
+            if self._truncates_left <= 0:
+                return False
+            if not self._matches(self.truncate.get("match"), path):
+                return False
+            self._truncates_left -= 1
+        size = os.path.getsize(path)
+        keep = self.truncate.get("keep_bytes")
+        if keep is None:
+            keep = int(size * float(self.truncate.get("keep_fraction", 0.5)))
+        keep = max(0, min(int(keep), size))
+        with open(path, "rb+") as f:
+            f.truncate(keep)
+        logger.warning("fault injection: tore %s to %d/%d bytes",
+                       path, keep, size)
+        return True
+
+    def maybe_delay_async(self) -> None:
+        if self.async_delay > 0:
+            time.sleep(self.async_delay)
+
+    def should_preempt(self, global_steps: int) -> bool:
+        """One-shot simulated preemption at step boundary >= N."""
+        with self._lock:
+            if self._preempted or self.preempt_at_step is None:
+                return False
+            if global_steps < self.preempt_at_step:
+                return False
+            self._preempted = True
+        return True
+
+
+# -------------------------------------------------------------- global access
+_injector: Optional[FaultInjector] = None
+_injector_lock = threading.Lock()
+
+
+def get_fault_injector() -> FaultInjector:
+    """Process-wide injector; built from ``DSTPU_FAULT_INJECTION`` on first use."""
+    global _injector
+    with _injector_lock:
+        if _injector is None:
+            _injector = FaultInjector.from_env()
+        return _injector
+
+
+def configure_fault_injection(spec: Optional[Dict[str, Any]]
+                              ) -> Optional[FaultInjector]:
+    """Install (or with ``None`` clear) the process-wide injector. After a
+    clear the next :func:`get_fault_injector` re-reads the env var."""
+    global _injector
+    with _injector_lock:
+        _injector = FaultInjector(spec) if spec is not None else None
+        return _injector
+
+
+# ------------------------------------------------------------------ retry I/O
+def retry_io(fn: Callable[[], T], *, attempts: int = 3,
+             base_delay: float = 0.01, max_delay: float = 0.5,
+             what: str = "storage I/O",
+             on_retry: Optional[Callable[[int, BaseException], None]] = None
+             ) -> T:
+    """Run ``fn`` retrying transient ``OSError`` with capped exponential
+    backoff — GCS/NFS blips and injected faults self-heal instead of killing
+    a multi-hour run. Each retry is recorded on the resilience counters
+    (``monitor/monitor.py``) so operators see degradation, not silence."""
+    last: Optional[BaseException] = None
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except OSError as e:
+            last = e
+            if attempt == attempts - 1:
+                break
+            from ..monitor.monitor import resilience_counters
+
+            resilience_counters.incr("io_retries")
+            if on_retry is not None:
+                on_retry(attempt + 1, e)
+            delay = min(max_delay, base_delay * (2 ** attempt))
+            logger.warning("%s failed (%s); retry %d/%d in %.3fs",
+                           what, e, attempt + 1, attempts - 1, delay)
+            time.sleep(delay)
+    from ..monitor.monitor import resilience_counters
+
+    resilience_counters.incr("io_giveups")
+    assert last is not None
+    raise last

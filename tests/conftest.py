@@ -24,13 +24,16 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: the suite is compile-bound (VERDICT r2 weak
-# #6 — the v2-engine tests alone build many jitted engine variants), and
-# most compiles repeat across files and across runs. ~/.cache-style dir keyed
-# by XLA fingerprint; safe to delete any time.
-jax.config.update("jax_compilation_cache_dir",
-                  os.environ.get("DSTPU_TEST_CACHE",
-                                 "/tmp/dstpu_jax_test_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# #6) and the cache used to be on by default — but its entry serialization is
+# unsafe in this environment: an interrupted/concurrent cache write corrupts
+# the process heap (mid-suite segfaults), and a torn entry then poisons every
+# later run that deserializes it (wrong executables → NaNs, deterministic
+# crashes at the same test). Resilience over speed: OFF unless a cache dir is
+# explicitly opted into via DSTPU_TEST_CACHE.
+_cache_dir = os.environ.get("DSTPU_TEST_CACHE")
+if _cache_dir:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pytest  # noqa: E402
 

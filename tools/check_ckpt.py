@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Offline checkpoint integrity checker.
+
+Verifies a checkpoint directory's manifest (sizes + crc32s recorded by
+``checkpoint/engine.py::save_tree`` under the ``__integrity__`` key of
+``dstpu_meta.json``) without loading any state onto devices — safe to run
+from a cron job or before scheduling a resume.
+
+Usage::
+
+    python tools/check_ckpt.py /path/to/save_dir/tag42     # one tag
+    python tools/check_ckpt.py /path/to/save_dir           # every tag + latest
+
+Given a save dir (a directory containing tag subdirectories), every tag is
+verified, the ``latest`` pointer is cross-checked against the newest valid
+tag, and orphaned ``.staging-*`` dirs are reported. Exit code 0 when
+everything referenced is healthy, 1 when any checked checkpoint is corrupt
+or ``latest`` dangles.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepspeedsyclsupport_tpu.checkpoint.engine import (  # noqa: E402
+    META_FILE, find_latest_valid_tag, list_tags, verify_tree)
+
+
+def _is_tag_dir(path: str) -> bool:
+    """A directory that looks like one checkpoint (has meta/index/state)."""
+    from deepspeedsyclsupport_tpu.checkpoint.engine import (INDEX_FILE,
+                                                            STATE_DIR)
+
+    return (os.path.exists(os.path.join(path, META_FILE))
+            or os.path.exists(os.path.join(path, INDEX_FILE))
+            or os.path.isdir(os.path.join(path, STATE_DIR)))
+
+
+def check_tag(path: str, verbose: bool = False) -> bool:
+    ok, reason = verify_tree(path)
+    status = "OK " if ok else "BAD"
+    print(f"{status} {path}: {reason}")
+    if ok and verbose:
+        try:
+            with open(os.path.join(path, META_FILE)) as f:
+                meta = json.load(f)
+            print(f"    global_steps={meta.get('global_steps')} "
+                  f"samples={meta.get('global_samples')}")
+        except (OSError, ValueError):
+            pass
+    return ok
+
+
+def check_save_dir(save_dir: str, verbose: bool = False) -> bool:
+    tags = list_tags(save_dir)
+    if not tags:
+        print(f"BAD {save_dir}: no checkpoint tags found")
+        return False
+    healthy = True
+    for tag in tags:
+        healthy &= check_tag(os.path.join(save_dir, tag), verbose)
+    for name in sorted(os.listdir(save_dir)):
+        if name.startswith(".staging"):
+            print(f"WARN {os.path.join(save_dir, name)}: orphaned staging "
+                  f"dir (interrupted save; promoted if complete, else swept "
+                  f"on next engine start)")
+    latest = os.path.join(save_dir, "latest")
+    if os.path.exists(latest):
+        with open(latest) as f:
+            pointed = f.read().strip()
+        ok, reason = verify_tree(os.path.join(save_dir, pointed))
+        if ok:
+            print(f"OK  latest -> {pointed}")
+        else:
+            healthy = False
+            fallback, _ = find_latest_valid_tag(save_dir)
+            print(f"BAD latest -> {pointed}: {reason}"
+                  + (f" (fallback load would resume {fallback!r})"
+                     if fallback else " (NO valid fallback exists)"))
+    else:
+        print(f"WARN {save_dir}: no 'latest' pointer")
+    return healthy
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="checkpoint tag dir, or a save dir "
+                                 "containing tag subdirectories")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="print step/sample metadata for healthy tags")
+    args = ap.parse_args(argv)
+    path = os.path.abspath(args.path)
+    if not os.path.isdir(path):
+        print(f"BAD {path}: not a directory")
+        return 1
+    if _is_tag_dir(path):
+        return 0 if check_tag(path, args.verbose) else 1
+    return 0 if check_save_dir(path, args.verbose) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
