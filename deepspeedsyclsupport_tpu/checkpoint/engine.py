@@ -208,6 +208,11 @@ def _save_native(path: str, state) -> None:
     retry_io(write_data, what=f"checkpoint data write {data_path}")
     _durable_write(index_path, json.dumps(index),
                    what=f"checkpoint index write {index_path}")
+    # observability spine: bytes written per save feeds Ckpt/* reporting
+    from ..monitor.telemetry import metrics_registry
+
+    metrics_registry.counter("ckpt_bytes_written").incr(
+        sum(e["nbytes"] for e in index))
 
 
 def _load_native(path: str, example, shardings):

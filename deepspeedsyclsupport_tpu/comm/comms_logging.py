@@ -134,6 +134,22 @@ class CommsLogger:
         return [(n, wall[n], float(allv[:, i].min()),
                  float(allv[:, i].max())) for i, n in enumerate(names)]
 
+    def summary_events(self, step: int):
+        """Per-op monitor events under the declared ``Comm/`` family
+        (``monitor/telemetry.py`` EVENT_PREFIXES) — how the comms island
+        feeds the shared observability stream. Keys are sanitized to the
+        ``Group/name`` charset (``all-reduce[data]`` → ``all-reduce.data``)."""
+        import re as _re
+
+        events = []
+        with self._lock:
+            for key in sorted(self._records):
+                rec = self._records[key]
+                name = _re.sub(r"[^\w.\-]", ".", key).strip(".")
+                events.append((f"Comm/{name}/count", rec.count, step))
+                events.append((f"Comm/{name}/bytes", rec.total_bytes, step))
+        return events
+
     def reset(self):
         with self._lock:
             self._records.clear()

@@ -53,7 +53,11 @@ class DSElasticAgent:
                  preemption_limit: Optional[int] = None,
                  env: Optional[Dict[str, str]] = None,
                  hostfile: Optional[str] = None,
-                 sleep_fn: Optional[Callable[[float], None]] = None):
+                 sleep_fn: Optional[Callable[[float], None]] = None,
+                 heartbeat_file: Optional[str] = None,
+                 heartbeat_timeout: Optional[float] = None,
+                 heartbeat_poll: float = 1.0,
+                 hang_grace: float = 5.0):
         self.cmd = list(cmd)
         self.ds_config = ds_config
         self.min_nodes = min_nodes
@@ -71,6 +75,17 @@ class DSElasticAgent:
         self._sleep = sleep_fn or time.sleep
         self.extra_env = dict(env or {})
         self.hostfile = hostfile
+        # Heartbeat watch (telemetry's per-rank freshness file,
+        # ``monitor/telemetry.py::Heartbeat``): when the worker's heartbeat
+        # goes stale past ``heartbeat_timeout`` the step is HUNG, not slow —
+        # demand a faulthandler stack dump (SIGUSR1, registered by the
+        # worker's telemetry), give it ``hang_grace`` seconds, then kill and
+        # restart. None disables the watch.
+        self.heartbeat_file = heartbeat_file
+        self.heartbeat_timeout = heartbeat_timeout
+        self.heartbeat_poll = heartbeat_poll
+        self.hang_grace = hang_grace
+        self.hang_count = 0
         self.restart_count = 0  # failures only — preemptions are free
         self.preemption_count = 0
         self.launch_history: List[Dict[str, Any]] = []
@@ -112,6 +127,66 @@ class DSElasticAgent:
             "DSTPU_ELASTIC_GAS": str(r.gradient_accumulation_steps),
         }
 
+    # ------------------------------------------------------------- heartbeat
+    def _heartbeat_stale(self, launched_at: float) -> bool:
+        from ..monitor.telemetry import Heartbeat
+
+        age = Heartbeat.age(self.heartbeat_file)
+        if age is None:
+            # no beat yet: a worker that hangs in init (distributed setup,
+            # first compile) never writes one — count staleness from launch.
+            # Enabling the watch therefore REQUIRES worker telemetry
+            # heartbeats; size the timeout to cover startup + first compile.
+            age = time.time() - launched_at
+        return age > self.heartbeat_timeout
+
+    def _launch(self, env: Dict[str, str]) -> int:
+        """Run one worker attempt. Without a heartbeat watch this is a plain
+        blocking wait; with one, poll the freshness file and escalate on
+        staleness: SIGUSR1 (worker faulthandler dumps all stacks) → grace →
+        SIGTERM → SIGKILL. A hang-killed worker returns a negative rc and is
+        counted as a failure by :meth:`run`."""
+        if self.heartbeat_file is None or self.heartbeat_timeout is None:
+            return subprocess.run(self.cmd, env=env).returncode
+        import signal
+
+        # a leftover heartbeat from the previous incarnation is stale by
+        # definition — without this every relaunch would be declared hung
+        # (and killed) before the fresh worker reaches its first beat
+        try:
+            os.unlink(self.heartbeat_file)
+        except OSError:
+            pass
+        launched_at = time.time()
+        proc = subprocess.Popen(self.cmd, env=env)
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                return rc
+            if self._heartbeat_stale(launched_at):
+                break
+            self._sleep(self.heartbeat_poll)
+        from ..monitor.monitor import resilience_counters
+
+        self.hang_count += 1
+        resilience_counters.incr("hang_restarts")
+        logger.error("elastic agent: heartbeat %s stale > %.1fs — worker "
+                     "hung; requesting stack dump then killing pid %d",
+                     self.heartbeat_file, self.heartbeat_timeout, proc.pid)
+        if hasattr(signal, "SIGUSR1"):
+            try:  # worker telemetry registered faulthandler on SIGUSR1
+                proc.send_signal(signal.SIGUSR1)
+            except OSError:  # pragma: no cover - it died under us
+                pass
+            self._sleep(self.hang_grace)
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=self.hang_grace)
+            except subprocess.TimeoutExpired:  # pragma: no cover
+                proc.kill()
+        return proc.wait()
+
     # ------------------------------------------------------------------ run
     def run(self) -> int:
         """Launch; restart on failure up to ``restart_limit`` times. A
@@ -139,15 +214,15 @@ class DSElasticAgent:
             env["DSTPU_ELASTIC_WORLD_SIZE"] = str(world)
             logger.info("elastic agent: launching (attempt %d, world=%d)",
                         self.restart_count + self.preemption_count + 1, world)
-            proc = subprocess.run(self.cmd, env=env)
+            rc = self._launch(env)
             self.launch_history.append(
-                {"world": world, "rc": proc.returncode,
+                {"world": world, "rc": rc,
                  "restart": self.restart_count,
-                 "preempted": proc.returncode == PREEMPTION_EXIT_CODE})
-            if proc.returncode == 0:
+                 "preempted": rc == PREEMPTION_EXIT_CODE})
+            if rc == 0:
                 return 0
             resilience_counters.incr("restarts")
-            if proc.returncode == PREEMPTION_EXIT_CODE:
+            if rc == PREEMPTION_EXIT_CODE:
                 # clean preemption: durable emergency checkpoint exists, the
                 # eviction wasn't the worker's fault — the restart is free,
                 # but not a hot loop: a fleet-wide drain SIGTERMs every
@@ -162,11 +237,11 @@ class DSElasticAgent:
                                  "exceeds limit %d — giving up",
                                  consecutive_preemptions,
                                  self.preemption_limit)
-                    return proc.returncode
+                    return rc
                 logger.warning("elastic agent: worker preempted (rc=%d, "
                                "preemption #%d) — restarting without "
                                "consuming restart budget",
-                               proc.returncode, self.preemption_count)
+                               rc, self.preemption_count)
                 delay = self.next_backoff(1)  # base only: no failure streak
                 if delay > 0:
                     self._sleep(delay)
@@ -177,12 +252,12 @@ class DSElasticAgent:
             if self.restart_count > self.restart_limit:
                 logger.error("elastic agent: restart limit %d exhausted "
                              "(last rc=%d)", self.restart_limit,
-                             proc.returncode)
-                return proc.returncode
+                             rc)
+                return rc
             delay = self.next_backoff(consecutive_failures)
             logger.warning("elastic agent: worker failed rc=%d — "
                            "re-discovering membership and restarting "
-                           "in %.2fs", proc.returncode, delay)
+                           "in %.2fs", rc, delay)
             if delay > 0:
                 self._sleep(delay)
 
@@ -205,6 +280,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--preemption-limit", type=int, default=None,
                     help="consecutive preemption exits before the agent "
                          "gives up (default: unbounded)")
+    ap.add_argument("--heartbeat-file", default=None,
+                    help="telemetry heartbeat file to watch (the worker's "
+                         "telemetry_logs/heartbeat_rank0.json)")
+    ap.add_argument("--heartbeat-timeout", type=float, default=None,
+                    help="seconds of heartbeat staleness before the worker "
+                         "is declared hung (stack-dumped via SIGUSR1, then "
+                         "killed and restarted)")
     ap.add_argument("--hostfile", default=None)
     ap.add_argument("cmd", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
@@ -217,6 +299,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                            backoff_seconds=args.backoff_seconds,
                            backoff_ceiling=args.backoff_ceiling,
                            preemption_limit=args.preemption_limit,
+                           heartbeat_file=args.heartbeat_file,
+                           heartbeat_timeout=args.heartbeat_timeout,
                            hostfile=args.hostfile)
     return agent.run()
 

@@ -1,2 +1,9 @@
-from .monitor import (CsvMonitor, Monitor, MonitorMaster, ResilienceCounters,
-                      TensorBoardMonitor, WandbMonitor, resilience_counters)
+from .monitor import (CsvMonitor, JsonlMonitor, Monitor, MonitorMaster,
+                      ResilienceCounters, TensorBoardMonitor, WandbMonitor,
+                      csv_filename_for_event, event_for_csv_filename,
+                      resilience_counters)
+from .telemetry import (EVENT_NAME_RE, EVENT_NAMES, EVENT_PREFIXES,
+                        FlightRecorder, GoodputAccounter, Heartbeat,
+                        MetricsRegistry, Telemetry, UndeclaredEventError,
+                        build_telemetry, check_events, declare_events,
+                        is_declared, metrics_registry)

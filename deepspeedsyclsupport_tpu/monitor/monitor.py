@@ -3,13 +3,25 @@
 Analog of ``deepspeed/monitor/`` — ``Monitor`` ABC + TensorBoard/W&B/CSV backends
 (``monitor/{monitor,tensorboard,wandb,csv_monitor}.py``, config ``monitor/config.py``).
 Same event contract: ``write_events([(name, value, global_step), ...])``.
+
+This layer now sits on the structured observability spine
+(:mod:`.telemetry`): event names are validated against the ``Group/name``
+registry before fan-out, and the :class:`JsonlMonitor` backend writes a
+rank-local JSONL stream shared with the flight recorder, so scalar metrics
+and step spans land interleaved in one crash-surviving file.
 """
 import csv
 import os
 import threading
+import urllib.parse
 from typing import TYPE_CHECKING, Any, Dict, List, Optional, Tuple
 
 from ..utils.logging import logger
+# ResilienceCounters moved to the telemetry spine; re-exported here because
+# the checkpoint writers / fault injection / elastic agent import them from
+# this module.
+from .telemetry import (ResilienceCounters, check_events,  # noqa: F401
+                        resilience_counters)
 
 if TYPE_CHECKING:  # import-time would cycle: runtime/__init__ -> engine ->
     from ..runtime.config import MonitorConfig  # monitor -> runtime.config
@@ -17,45 +29,8 @@ if TYPE_CHECKING:  # import-time would cycle: runtime/__init__ -> engine ->
 Event = Tuple[str, Any, int]
 
 
-class ResilienceCounters:
-    """Process-wide degradation counters (ISSUE: operators must *see* retries,
-    fallback loads, emergency saves and restarts instead of discovering them
-    at recovery time). Incremented by the checkpoint writers, the preemption
-    handler and the elastic agent; the engine surfaces changed counters as
-    ``Resilience/*`` monitor events at its print boundaries."""
-
-    NAMES = ("io_retries", "io_giveups", "corrupt_tags_skipped",
-             "fallback_loads", "emergency_saves", "preemptions",
-             "staging_sweeps", "staging_promotions", "checkpoints_rotated",
-             "restarts")
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._counts: Dict[str, int] = dict.fromkeys(self.NAMES, 0)
-
-    def incr(self, name: str, n: int = 1) -> int:
-        with self._lock:
-            self._counts[name] = self._counts.get(name, 0) + n
-            return self._counts[name]
-
-    def get(self, name: str) -> int:
-        with self._lock:
-            return self._counts.get(name, 0)
-
-    def snapshot(self) -> Dict[str, int]:
-        with self._lock:
-            return dict(self._counts)
-
-    def reset(self) -> None:
-        with self._lock:
-            self._counts = dict.fromkeys(self.NAMES, 0)
-
-
-resilience_counters = ResilienceCounters()
-
-
 class Monitor:
-    def __init__(self, config: "MonitorConfig"):
+    def __init__(self, config: Optional["MonitorConfig"] = None):
         self.config = config
         self.enabled = True
 
@@ -69,29 +44,65 @@ class Monitor:
         pass
 
 
+def csv_filename_for_event(name: str) -> str:
+    """Reversible metric-name → filename mapping. The old ``replace('/', '_')``
+    collapsed ``a/b`` and ``a_b`` onto one file; percent-encoding keeps every
+    distinct event name on a distinct file and :func:`event_for_csv_filename`
+    inverts it exactly."""
+    return urllib.parse.quote(name, safe="") + ".csv"
+
+
+def event_for_csv_filename(fname: str) -> str:
+    base = fname[:-4] if fname.endswith(".csv") else fname
+    return urllib.parse.unquote(base)
+
+
 class CsvMonitor(Monitor):
-    """CSV backend (reference: ``monitor/csv_monitor.py``): one file per metric."""
+    """CSV backend (reference: ``monitor/csv_monitor.py``): one file per metric.
+
+    Hardening over the reference port: reversible file naming (no more
+    ``a/b`` vs ``a_b`` collisions), non-numeric event values are skipped
+    with a warning instead of raising mid-flush, and files are flushed every
+    ``flush_interval`` write batches instead of only at ``close()`` — a
+    preempted run keeps its metrics."""
 
     def __init__(self, config: "MonitorConfig"):
         super().__init__(config)
         self.base = os.path.join(config.csv_output_path or "csv_logs",
                                  config.csv_job_name)
         os.makedirs(self.base, exist_ok=True)
+        self.flush_interval = max(1, int(
+            getattr(config, "csv_flush_interval", 10)))
         self._files = {}
+        self._writes_since_flush = 0
+        self._warned_bad_values = set()
 
     def _writer(self, name: str):
         if name not in self._files:
-            path = os.path.join(self.base, name.replace("/", "_") + ".csv")
+            path = os.path.join(self.base, csv_filename_for_event(name))
             f = open(path, "a", newline="")
             self._files[name] = (f, csv.writer(f))
         return self._files[name]
 
     def write_events(self, events: List[Event]) -> None:
         for name, value, step in events:
+            try:
+                value = float(value)
+            except (TypeError, ValueError):
+                if name not in self._warned_bad_values:
+                    self._warned_bad_values.add(name)
+                    logger.warning(
+                        "CsvMonitor: non-numeric value %r for event %r; "
+                        "skipped (further occurrences silenced)", value, name)
+                continue
             f, w = self._writer(name)
-            w.writerow([step, float(value)])
+            w.writerow([step, value])
+        self._writes_since_flush += 1
+        if self._writes_since_flush >= self.flush_interval:
+            self.flush()
 
     def flush(self) -> None:
+        self._writes_since_flush = 0
         for f, _ in self._files.values():
             f.flush()
 
@@ -99,6 +110,116 @@ class CsvMonitor(Monitor):
         for f, _ in self._files.values():
             f.close()
         self._files.clear()
+
+
+class JsonlMonitor(Monitor):
+    """Rank-local structured JSONL backend — the flight recorder's disk sink.
+
+    Unlike the scalar backends this one exists on EVERY rank (per-host
+    telemetry is the point: stragglers and preemptions are per-host
+    phenomena). Scalar events become ``{"kind": "metric", ...}`` lines;
+    flight-recorder records (spans, compile events, memory samples, dump
+    markers) are appended through :meth:`write_record` interleaved in arrival
+    order. Lines are buffered and flushed every ``flush_interval`` records —
+    ``dump()``/``flush()`` force-drains, which is what the preemption handler
+    relies on."""
+
+    def __init__(self, config: Optional["MonitorConfig"] = None,
+                 path: Optional[str] = None, flush_interval: int = 64):
+        super().__init__(config)
+        if path is None:
+            if config is None or not getattr(config, "jsonl_enabled", False):
+                raise ValueError("JsonlMonitor needs a path or a config with "
+                                 "jsonl_enabled")
+            import jax
+
+            path = os.path.join(
+                config.jsonl_output_path or "telemetry_logs",
+                config.jsonl_job_name,
+                f"flightrec_rank{jax.process_index()}.jsonl")
+            flush_interval = getattr(config, "jsonl_flush_interval",
+                                     flush_interval)
+        self.path = path
+        self.flush_interval = max(1, int(flush_interval))
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._lock = threading.Lock()
+        self._buf: List[str] = []
+        self._file = None
+        self._recorder = None
+
+    def attach_recorder(self, recorder) -> None:
+        """Become the flight recorder's sink; subsequent scalar events are
+        routed THROUGH the recorder (one ring, one stream) instead of being
+        written directly."""
+        if self._recorder is recorder:
+            return
+        self._recorder = recorder
+        recorder.add_sink(self.write_record, flush=self.flush)
+
+    # --------------------------------------------------------------- writing
+    def write_events(self, events: List[Event]) -> None:
+        if self._recorder is not None:
+            for name, value, step in events:
+                self._recorder.record("metric", name, step=step,
+                                      value=_jsonable_value(value))
+            return
+        for name, value, step in events:
+            self.write_record({"kind": "metric", "name": name,
+                               "step": step,
+                               "value": _jsonable_value(value)})
+
+    def write_record(self, rec: Dict[str, Any]) -> None:
+        import json
+
+        try:
+            line = json.dumps(rec, default=_json_default)
+        except (TypeError, ValueError) as e:
+            logger.warning("JsonlMonitor: unserializable record %r (%s); "
+                           "skipped", rec.get("name"), e)
+            return
+        with self._lock:
+            self._buf.append(line)
+            should_flush = len(self._buf) >= self.flush_interval
+        if should_flush:
+            self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            if not self._buf:
+                return
+            lines, self._buf = self._buf, []
+            if self._file is None:
+                self._file = open(self.path, "a")
+            self._file.write("\n".join(lines) + "\n")
+            self._file.flush()
+
+    def close(self) -> None:
+        self.flush()
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+def _jsonable_value(value: Any) -> Any:
+    """Scalar-ify device arrays / numpy scalars for JSON."""
+    try:
+        import json
+
+        json.dumps(value)
+        return value
+    except (TypeError, ValueError):
+        try:
+            return float(value)
+        except (TypeError, ValueError):
+            return repr(value)
+
+
+def _json_default(obj: Any) -> Any:
+    try:
+        return float(obj)
+    except (TypeError, ValueError):
+        return repr(obj)
 
 
 class TensorBoardMonitor(Monitor):
@@ -157,8 +278,15 @@ class WandbMonitor(Monitor):
 
 
 class MonitorMaster(Monitor):
-    """Fan-out to all enabled backends; only process rank 0 writes (reference:
-    ``monitor/monitor.py`` MonitorMaster rank gating)."""
+    """Fan-out to all enabled backends; only process rank 0 writes the scalar
+    backends (reference: ``monitor/monitor.py`` MonitorMaster rank gating),
+    while the JSONL flight-recorder backend is rank-LOCAL by design.
+
+    Every event batch is validated against the telemetry event-name registry
+    first: names must match ``Group/name`` and be declared
+    (``monitor/telemetry.py`` ``EVENT_NAMES``/``EVENT_PREFIXES``). Under
+    strict mode (``DSTPU_STRICT_EVENTS=1`` — on in the test suite) an
+    undeclared name raises; otherwise it warns once and passes through."""
 
     def __init__(self, config: "MonitorConfig"):
         super().__init__(config)
@@ -172,9 +300,12 @@ class MonitorMaster(Monitor):
                 self.monitors.append(WandbMonitor(config))
             if config.csv_enabled:
                 self.monitors.append(CsvMonitor(config))
+        if getattr(config, "jsonl_enabled", False):
+            self.monitors.append(JsonlMonitor(config))
         self.enabled = any(m.enabled for m in self.monitors)
 
     def write_events(self, events: List[Event]) -> None:
+        events = check_events(events)
         for m in self.monitors:
             if m.enabled:
                 m.write_events(events)

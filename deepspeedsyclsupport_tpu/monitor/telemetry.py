@@ -1,0 +1,858 @@
+"""Structured observability spine: metrics registry + flight recorder + goodput.
+
+The repo grew four disconnected telemetry islands — wall-clock timers
+(``utils/timer.py``), trace-time comms accounting (``comm/comms_logging.py``),
+static FLOPS profiling (``profiling/flops_profiler.py``) and the resilience
+counters — none of which left an on-disk record that survives a crash. This
+module is the shared spine they are re-pointed at:
+
+* :class:`MetricsRegistry` — process-wide counters / gauges / fixed-bucket
+  histograms, cheap enough for the step hot path.
+* :class:`FlightRecorder` — a bounded in-memory ring of structured records
+  (step spans, compile events, memory samples, checkpoint spans, metric
+  writes) that streams to a rank-local JSONL sink and is force-dumped on
+  crash/SIGTERM, so the last N steps before any death are always on disk.
+* :class:`GoodputAccounter` — attributes wall-clock to productive step time
+  vs. checkpoint, compile, startup and residual overhead; the ``Goodput/*``
+  events answer "what fraction of wall-clock was productive training?".
+* recompile detection — a ``jax.monitoring`` listener counting jit cache
+  misses and their wall-time, so a shape-thrash loop shows up as
+  ``Compile/*`` events with the offending arg-shape diff attached.
+* :class:`Heartbeat` — a per-rank freshness file the elastic agent watches to
+  tell hung steps from slow steps (stale heartbeat → ``faulthandler`` stack
+  dump before restart).
+* the **event-name registry** — every scalar event emitted through
+  ``MonitorMaster`` must match the ``Group/name`` convention and be declared
+  here (exact name or family prefix); a typo'd metric name fails tests
+  instead of silently forking a new CSV file.
+
+``tools/trace_report.py`` renders the JSONL stream offline into a step
+timeline / goodput / straggler summary. Format: one JSON object per line,
+``{"seq", "t", "kind", "name", "step", "dur", "value", "data"}`` with absent
+fields omitted; ``kind`` ∈ meta | span | event | metric | gauge | counter |
+goodput | dump.
+
+No module-level imports from sibling packages (``monitor.monitor`` imports
+this module; everything else here is imported lazily to keep the dependency
+graph acyclic).
+"""
+import contextlib
+import json
+import os
+import re
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from ..utils.logging import logger
+
+Event = Tuple[str, Any, int]
+
+# =========================================================================
+# Resilience counters (moved here from monitor/monitor.py — the degradation
+# counters are one island this module unifies; monitor.py re-exports them
+# for backwards compatibility).
+# =========================================================================
+
+
+class ResilienceCounters:
+    """Process-wide degradation counters (operators must *see* retries,
+    fallback loads, emergency saves and restarts instead of discovering them
+    at recovery time). Incremented by the checkpoint writers, the preemption
+    handler and the elastic agent; the engine surfaces changed counters as
+    ``Resilience/*`` monitor events at its print boundaries."""
+
+    NAMES = ("io_retries", "io_giveups", "corrupt_tags_skipped",
+             "fallback_loads", "emergency_saves", "preemptions",
+             "staging_sweeps", "staging_promotions", "checkpoints_rotated",
+             "restarts", "hang_restarts")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = dict.fromkeys(self.NAMES, 0)
+
+    def incr(self, name: str, n: int = 1) -> int:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+            return self._counts[name]
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = dict.fromkeys(self.NAMES, 0)
+
+
+resilience_counters = ResilienceCounters()
+
+# =========================================================================
+# Event-name registry
+# =========================================================================
+
+#: ``Group/name`` convention: slash-separated segments of word chars / dots /
+#: dashes, at least two segments. ``Train/Samples/train_loss`` ✓, ``loss`` ✗.
+EVENT_NAME_RE = re.compile(r"^[A-Za-z0-9][\w.\-]*(/[\w.\-]+)+$")
+
+#: Exact declared event names. Anything the engine emits through
+#: ``MonitorMaster`` must appear here (or match a family prefix below) —
+#: the tier-1 guard test runs with strict mode on, so a typo'd name raises
+#: instead of silently forking a new CSV file.
+EVENT_NAMES = frozenset(
+    {"Train/Samples/train_loss", "Train/Samples/lr",
+     "Train/Samples/loss_scale",
+     "Goodput/productive_s", "Goodput/checkpoint_s", "Goodput/compile_s",
+     "Goodput/startup_s", "Goodput/other_s", "Goodput/total_s",
+     "Goodput/productive_frac",
+     "Memory/bytes_in_use", "Memory/peak_bytes_in_use",
+     "Compile/count", "Compile/total_s",
+     "Ckpt/save_s", "Ckpt/bytes_written"}
+    | {f"Resilience/{n}" for n in ResilienceCounters.NAMES})
+
+#: Families whose member names are data-dependent (collective op mix, user
+#: extensions). A prefix declares the whole family.
+EVENT_PREFIXES = ("Comm/", "Custom/")
+
+_extra_event_names: set = set()
+_warned_names: set = set()
+
+
+class UndeclaredEventError(ValueError):
+    """An event name violating the convention / registry under strict mode."""
+
+
+def declare_events(names: Iterable[str]) -> None:
+    """Register additional exact event names (user extensions). Names must
+    already match the ``Group/name`` convention."""
+    for name in names:
+        if not EVENT_NAME_RE.match(name):
+            raise UndeclaredEventError(
+                f"event name {name!r} does not match the Group/name "
+                f"convention ({EVENT_NAME_RE.pattern})")
+        _extra_event_names.add(name)
+
+
+def is_declared(name: str) -> bool:
+    if not EVENT_NAME_RE.match(name):
+        return False
+    if name in EVENT_NAMES or name in _extra_event_names:
+        return True
+    return any(name.startswith(p) for p in EVENT_PREFIXES)
+
+
+def events_strict() -> bool:
+    """Strict mode: undeclared names raise instead of warn. On under pytest
+    (tests/conftest.py sets ``DSTPU_STRICT_EVENTS=1``) and for any operator
+    who exports it."""
+    return os.environ.get("DSTPU_STRICT_EVENTS", "0").lower() in ("1", "true")
+
+
+def check_events(events: List[Event]) -> List[Event]:
+    """Validate event names against the registry. Strict mode raises
+    :class:`UndeclaredEventError`; otherwise undeclared names warn once and
+    pass through (operators keep their data, CI keeps its guard)."""
+    for name, _value, _step in events:
+        if is_declared(name):
+            continue
+        msg = (f"event name {name!r} is not declared in "
+               f"monitor.telemetry.EVENT_NAMES / EVENT_PREFIXES (or violates "
+               f"the Group/name convention); declare it via "
+               f"declare_events([...])")
+        if events_strict():
+            raise UndeclaredEventError(msg)
+        if name not in _warned_names:
+            _warned_names.add(name)
+            logger.warning(msg)
+    return events
+
+
+# =========================================================================
+# Metrics registry
+# =========================================================================
+
+#: Default histogram buckets for durations in seconds (5 ms … 2 min).
+DURATION_BUCKETS_S = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                      5.0, 10.0, 30.0, 60.0, 120.0)
+
+
+class Counter:
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def incr(self, n: int = 1) -> int:
+        with self._lock:
+            self._value += n
+            return self._value
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Gauge:
+    __slots__ = ("name", "_value", "_t")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value: float = 0.0
+        self._t: float = 0.0
+
+    def set(self, value: float) -> None:
+        self._value = float(value)
+        self._t = time.time()
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram (Prometheus-style cumulative-le buckets)."""
+
+    __slots__ = ("name", "buckets", "counts", "_sum", "_count", "_lock")
+
+    def __init__(self, name: str, buckets: Tuple[float, ...] = DURATION_BUCKETS_S):
+        self.name = name
+        self.buckets = tuple(sorted(buckets))
+        self.counts = [0] * (len(self.buckets) + 1)  # +inf overflow bucket
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self._sum += value
+            self._count += 1
+            for i, edge in enumerate(self.buckets):
+                if value <= edge:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"buckets": list(self.buckets), "counts": list(self.counts),
+                    "sum": self._sum, "count": self._count}
+
+
+class MetricsRegistry:
+    """Process-wide named metrics. Creation is idempotent; the hot path is a
+    dict lookup + a lock-free-ish update on the metric object itself."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            if name not in self._counters:
+                self._counters[name] = Counter(name)
+            return self._counters[name]
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            if name not in self._gauges:
+                self._gauges[name] = Gauge(name)
+            return self._gauges[name]
+
+    def histogram(self, name: str,
+                  buckets: Tuple[float, ...] = DURATION_BUCKETS_S) -> Histogram:
+        with self._lock:
+            if name not in self._histograms:
+                self._histograms[name] = Histogram(name, buckets)
+            return self._histograms[name]
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "counters": {n: c.value for n, c in self._counters.items()},
+                "gauges": {n: g.value for n, g in self._gauges.items()},
+                "histograms": {n: h.snapshot()
+                               for n, h in self._histograms.items()},
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+#: Process-wide registry (the analog of ``resilience_counters`` for general
+#: metrics; checkpoint writers and the engine feed it).
+metrics_registry = MetricsRegistry()
+
+
+# =========================================================================
+# Flight recorder
+# =========================================================================
+
+
+class FlightRecorder:
+    """Bounded ring of structured telemetry records.
+
+    Every record is appended to an in-memory deque (``capacity`` newest
+    records survive) and forwarded to any attached sinks (the rank-local
+    JSONL writer). ``dump()`` force-flushes the sinks — wired into the
+    preemption handler so the last steps before a SIGTERM are on disk."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = int(capacity)
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._sinks: List[Tuple[Callable[[Dict[str, Any]], None],
+                                Optional[Callable[[], None]]]] = []
+
+    def add_sink(self, write_record: Callable[[Dict[str, Any]], None],
+                 flush: Optional[Callable[[], None]] = None) -> None:
+        """Register a per-record writer and (optionally) the flush that
+        :meth:`dump` must call to force its buffer onto disk — explicit, so
+        plain-function sinks don't silently lose their tail on a crash."""
+        self._sinks.append((write_record, flush))
+
+    # ------------------------------------------------------------- recording
+    def record(self, kind: str, name: str, step: Optional[int] = None,
+               dur: Optional[float] = None, value: Any = None,
+               data: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        rec: Dict[str, Any] = {"kind": kind, "name": name, "t": time.time()}
+        if step is not None:
+            rec["step"] = int(step)
+        if dur is not None:
+            rec["dur"] = float(dur)
+        if value is not None:
+            rec["value"] = value
+        if data:
+            rec["data"] = data
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._ring.append(rec)
+            sinks = tuple(self._sinks)
+        for write, _flush in sinks:
+            try:
+                write(rec)
+            except Exception as e:  # telemetry must never kill training
+                logger.warning("flight-recorder sink failed: %s", e)
+        return rec
+
+    def event(self, name: str, step: Optional[int] = None, **data) -> Dict[str, Any]:
+        return self.record("event", name, step=step, data=data or None)
+
+    @contextlib.contextmanager
+    def span(self, name: str, step: Optional[int] = None,
+             data: Optional[Dict[str, Any]] = None):
+        """Measure a region; the record lands on exit with its duration."""
+        t0 = time.perf_counter()
+        extra: Dict[str, Any] = dict(data or {})
+        try:
+            yield extra
+        finally:
+            self.record("span", name, step=step,
+                        dur=time.perf_counter() - t0, data=extra or None)
+
+    # ------------------------------------------------------------- inspection
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._ring)
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def dump(self, reason: str = "manual") -> List[Dict[str, Any]]:
+        """Record a dump marker (with the metrics-registry snapshot inlined)
+        and force-flush every sink. Returns the ring contents."""
+        self.record("dump", "flight_recorder/dump",
+                    data={"reason": reason,
+                          "metrics": metrics_registry.snapshot(),
+                          "resilience": resilience_counters.snapshot()})
+        for _write, flush in tuple(self._sinks):
+            if flush is None:
+                continue
+            try:
+                flush()
+            except Exception as e:
+                logger.warning("flight-recorder dump flush failed: %s", e)
+        return self.snapshot()
+
+
+# Active recorder: the seam through which re-pointed islands
+# (``utils/timer.py`` spans, checkpoint writers) reach the current engine's
+# recorder without holding a reference. Last telemetry constructed wins.
+_active_recorder: Optional[FlightRecorder] = None
+
+
+def set_active_recorder(rec: Optional[FlightRecorder]) -> None:
+    global _active_recorder
+    _active_recorder = rec
+
+
+def get_active_recorder() -> Optional[FlightRecorder]:
+    return _active_recorder
+
+
+# =========================================================================
+# Recompile detection (jit cache misses)
+# =========================================================================
+
+_compile_lock = threading.Lock()
+_compile_count = 0
+_compile_seconds = 0.0
+_compile_listener_installed = False
+
+
+def _on_jax_event(event: str, duration_secs: float, **_kw) -> None:
+    global _compile_count, _compile_seconds
+    if not event.startswith("/jax/core/compile"):
+        return
+    with _compile_lock:
+        # one backend_compile per executable build; trace/lower sub-phases
+        # only contribute wall-time
+        if event.endswith("backend_compile_duration"):
+            _compile_count += 1
+        _compile_seconds += duration_secs
+
+
+def install_compile_listener() -> None:
+    """Register the process-wide ``jax.monitoring`` listener (idempotent —
+    jax offers no unregister, so exactly one is ever installed)."""
+    global _compile_listener_installed
+    with _compile_lock:
+        if _compile_listener_installed:
+            return
+        _compile_listener_installed = True
+    import jax.monitoring
+
+    jax.monitoring.register_event_duration_secs_listener(_on_jax_event)
+
+
+def compile_stats() -> Tuple[int, float]:
+    """(total executable compiles, total compile wall-seconds) so far."""
+    with _compile_lock:
+        return _compile_count, _compile_seconds
+
+
+def tree_shapes(tree: Any) -> Dict[str, str]:
+    """Flat ``leaf-path -> shape/dtype`` map for arg-shape diffing."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = {}
+    for path, leaf in flat:
+        key = "/".join(str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k))))
+                       for k in path)
+        shape = getattr(leaf, "shape", ())
+        dtype = getattr(leaf, "dtype", type(leaf).__name__)
+        out[key] = f"{tuple(shape)}:{dtype}"
+    return out
+
+
+def shape_diff(old: Optional[Dict[str, str]],
+               new: Dict[str, str]) -> Dict[str, Any]:
+    """What changed between two shape maps — the offending diff logged with a
+    recompile event."""
+    if old is None:
+        return {"initial": True}
+    changed = {k: {"was": old[k], "now": v}
+               for k, v in new.items() if k in old and old[k] != v}
+    added = sorted(set(new) - set(old))
+    removed = sorted(set(old) - set(new))
+    out: Dict[str, Any] = {}
+    if changed:
+        out["changed"] = changed
+    if added:
+        out["added"] = added
+    if removed:
+        out["removed"] = removed
+    return out or {"identical_shapes": True}
+
+
+# =========================================================================
+# Goodput accounting
+# =========================================================================
+
+
+class GoodputAccounter:
+    """Attribute wall-clock since construction to named categories.
+
+    ``other`` is the residual (total − sum of known categories), so the
+    split accounts for 100% of measured wall-clock by construction — the
+    report tool asserts ≥99% survives serialization/rounding."""
+
+    CATEGORIES = ("productive", "checkpoint", "compile", "startup", "other")
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._t0 = clock()
+        self._lock = threading.Lock()
+        self._buckets: Dict[str, float] = {c: 0.0 for c in self.CATEGORIES
+                                           if c != "other"}
+        self._first_step_seen = False
+
+    def account(self, category: str, seconds: float) -> None:
+        if seconds < 0:
+            return
+        with self._lock:
+            self._buckets[category] = self._buckets.get(category, 0.0) + seconds
+
+    def mark_first_step(self) -> None:
+        """Everything before the first step is startup (process boot, tracing
+        done outside steps, checkpoint resume)."""
+        with self._lock:
+            if self._first_step_seen:
+                return
+            self._first_step_seen = True
+            known = sum(self._buckets.values())
+            self._buckets["startup"] = max(
+                0.0, (self._clock() - self._t0) - known)
+
+    def summary(self) -> Dict[str, float]:
+        with self._lock:
+            total = max(1e-9, self._clock() - self._t0)
+            buckets = dict(self._buckets)
+        known = sum(buckets.values())
+        buckets["other"] = max(0.0, total - known)
+        buckets["total"] = total
+        buckets["productive_frac"] = buckets.get("productive", 0.0) / total
+        return buckets
+
+    def events(self, step: int) -> List[Event]:
+        s = self.summary()
+        ev: List[Event] = [(f"Goodput/{c}_s", s.get(c, 0.0), step)
+                           for c in self.CATEGORIES]
+        ev.append(("Goodput/total_s", s["total"], step))
+        ev.append(("Goodput/productive_frac", s["productive_frac"], step))
+        return ev
+
+
+# =========================================================================
+# Heartbeat
+# =========================================================================
+
+
+class Heartbeat:
+    """Per-rank freshness file: ``{"t", "step", "pid"}``, rewritten atomically
+    at most every ``interval_s``. The elastic agent compares the recorded
+    wall time against its clock to tell a hung worker from a slow one."""
+
+    def __init__(self, path: str, interval_s: float = 1.0,
+                 clock: Callable[[], float] = time.time):
+        self.path = path
+        self.interval_s = float(interval_s)
+        self._clock = clock
+        self._last: Optional[float] = None  # first beat always writes
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def beat(self, step: int, force: bool = False) -> bool:
+        now = self._clock()
+        if not force and self._last is not None \
+                and now - self._last < self.interval_s:
+            return False
+        self._last = now
+        tmp = f"{self.path}.tmp{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"t": now, "step": int(step), "pid": os.getpid()}, f)
+            os.replace(tmp, self.path)
+        except OSError as e:  # heartbeat failure must never kill training
+            logger.warning("heartbeat write failed: %s", e)
+            return False
+        return True
+
+    @staticmethod
+    def read(path: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    @staticmethod
+    def age(path: str, now: Optional[float] = None) -> Optional[float]:
+        """Seconds since the last beat, or None if unreadable."""
+        hb = Heartbeat.read(path)
+        if hb is None or "t" not in hb:
+            return None
+        return (now if now is not None else time.time()) - float(hb["t"])
+
+
+_faulthandler_installed = False
+
+
+def install_hang_dump(stack_path: str) -> bool:
+    """Register ``faulthandler`` on SIGUSR1 so the elastic agent can demand a
+    stack dump from a hung worker before restarting it. Idempotent; returns
+    whether the handler is (now) installed."""
+    global _faulthandler_installed
+    if _faulthandler_installed:
+        return True
+    import faulthandler
+    import signal
+
+    if not hasattr(signal, "SIGUSR1"):  # pragma: no cover - non-posix
+        return False
+    try:
+        os.makedirs(os.path.dirname(stack_path) or ".", exist_ok=True)
+        f = open(stack_path, "a")
+        faulthandler.register(signal.SIGUSR1, file=f, all_threads=True)
+    except (OSError, ValueError, RuntimeError) as e:  # pragma: no cover
+        logger.warning("faulthandler hang-dump unavailable: %s", e)
+        return False
+    _faulthandler_installed = True
+    return True
+
+
+# =========================================================================
+# Telemetry facade (what the engine holds)
+# =========================================================================
+
+
+class Telemetry:
+    """Everything observability, wired together for one engine.
+
+    The engine calls :meth:`on_step_end` after every ``train_batch``,
+    :meth:`ckpt_span` around checkpoint saves, and the preemption handler
+    calls :meth:`dump` before the process dies. Construction cost is one
+    ring + (optionally) a JSONL file open; the per-step cost is a few dict
+    appends — the <5% overhead guarantee lives in the tier-1 suite."""
+
+    def __init__(self, cfg: Any, jsonl: Any = None, rank: int = 0):
+        self.cfg = cfg
+        self.rank = rank
+        self.recorder = FlightRecorder(capacity=cfg.ring_size)
+        self.registry = metrics_registry
+        self.goodput = GoodputAccounter() if cfg.goodput_enabled else None
+        self.jsonl = jsonl
+        self._closed = False
+        self._last_shapes: Optional[Dict[str, str]] = None
+        self._compile_base = (0, 0.0)
+        self._last_memory_step = -1
+        self._last_step_end: Optional[float] = None
+        self._step_hist = self.registry.histogram("step_time_s")
+        self.heartbeat: Optional[Heartbeat] = None
+        if cfg.heartbeat_enabled:
+            self.heartbeat = Heartbeat(
+                os.path.join(cfg.output_dir, f"heartbeat_rank{rank}.json"),
+                interval_s=cfg.heartbeat_interval_s)
+            if cfg.stack_dump_on_hang:
+                install_hang_dump(
+                    os.path.join(cfg.output_dir, f"stacks_rank{rank}.txt"))
+        install_compile_listener()
+        self._compile_base = compile_stats()
+        if jsonl is not None and hasattr(jsonl, "attach_recorder"):
+            jsonl.attach_recorder(self.recorder)
+        self.recorder.record(
+            "meta", "flight_recorder/start",
+            data={"rank": rank, "pid": os.getpid(), "version": 1,
+                  "ring_size": cfg.ring_size})
+        set_active_recorder(self.recorder)
+        import atexit
+
+        atexit.register(self.close)
+
+    # ------------------------------------------------------------- step path
+    def on_step_end(self, step: int, dur: Optional[float] = None,
+                    batch: Any = None) -> None:
+        """Per-step accounting: step span into the ring, duration histogram,
+        recompile attribution (with arg-shape diff), goodput, heartbeat and
+        periodic memory gauges.
+
+        ``dur`` is the caller-measured step wall; ``None`` (the eager
+        ``forward/backward/step`` path) falls back to boundary-to-boundary
+        timing — the whole gap since the previous step end, data time
+        included. Either way this is HOST wall-clock: under async dispatch
+        a span covers dispatch (throttled to device pace by XLA's bounded
+        in-flight queue), and sync points land in goodput's ``other``. Set
+        ``telemetry.sync_timing`` for device-accurate per-step spans at the
+        cost of dispatch/compute overlap."""
+        now = time.perf_counter()
+        if dur is None:
+            dur = (now - self._last_step_end
+                   if self._last_step_end is not None else 0.0)
+        self._last_step_end = now
+        count, seconds = compile_stats()
+        d_count = count - self._compile_base[0]
+        d_seconds = seconds - self._compile_base[1]
+        # rebase unconditionally: trace/lower durations arrive even without a
+        # backend compile (cache hits, HLO re-lowering) and must not be
+        # re-deducted from 'productive' on every later step
+        self._compile_base = (count, seconds)
+        span_data: Optional[Dict[str, Any]] = None
+        if d_count > 0:
+            self.registry.counter("recompiles").incr(d_count)
+            new_shapes = tree_shapes(batch) if batch is not None else {}
+            diff = shape_diff(self._last_shapes, new_shapes)
+            self._last_shapes = new_shapes
+            self.recorder.record("event", "compile/train_step", step=step,
+                                 dur=d_seconds,
+                                 data={"compiles": d_count,
+                                       "shape_diff": diff})
+            span_data = {"compiles": d_count, "compile_s": d_seconds}
+        elif batch is not None and self._last_shapes is None:
+            self._last_shapes = tree_shapes(batch)
+        self.recorder.record("span", "step", step=step, dur=dur,
+                             data=span_data)
+        self._step_hist.observe(dur)
+        if self.goodput is not None:
+            # account this step BEFORE marking first-step: startup is the
+            # residual of everything before it, so the first step's own
+            # compile/compute must already be in their buckets or it would
+            # be double-counted into startup
+            self.goodput.account("compile", min(d_seconds, dur))
+            self.goodput.account("productive", max(0.0, dur - d_seconds))
+            self.goodput.mark_first_step()
+        if self.heartbeat is not None:
+            self.heartbeat.beat(step)
+        interval = self.cfg.memory_interval_steps
+        if interval > 0 and step - self._last_memory_step >= interval:
+            self._last_memory_step = step
+            self.sample_memory(step)
+
+    def sample_memory(self, step: int) -> Dict[str, int]:
+        from ..accelerator import get_accelerator
+
+        try:
+            stats = get_accelerator().memory_stats() or {}
+        except Exception as e:  # pragma: no cover - backend dependent
+            logger.warning("memory_stats unavailable: %s", e)
+            return {}
+        in_use = int(stats.get("bytes_in_use", 0))
+        peak = int(stats.get("peak_bytes_in_use", 0))
+        self.registry.gauge("hbm_bytes_in_use").set(in_use)
+        self.registry.gauge("hbm_peak_bytes_in_use").set(peak)
+        self.recorder.record("gauge", "memory/hbm", step=step,
+                             data={"bytes_in_use": in_use,
+                                   "peak_bytes_in_use": peak})
+        return {"bytes_in_use": in_use, "peak_bytes_in_use": peak}
+
+    @contextlib.contextmanager
+    def ckpt_span(self, what: str = "save", step: int = 0):
+        """Wraps checkpoint saves: a ``ckpt`` span in the ring + goodput's
+        checkpoint bucket. Forces heartbeats at entry/exit so a long save
+        doesn't read as a silent gap — but a save longer than the agent's
+        ``heartbeat_timeout`` will still be declared hung: size the timeout
+        to cover the worst-case checkpoint, not just a step."""
+        if self.heartbeat is not None:
+            self.heartbeat.beat(step, force=True)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dur = time.perf_counter() - t0
+            self.recorder.record("span", f"ckpt/{what}", dur=dur)
+            self.registry.histogram("ckpt_save_s").observe(dur)
+            if self.goodput is not None:
+                self.goodput.account("checkpoint", dur)
+            if self.heartbeat is not None:
+                self.heartbeat.beat(step, force=True)
+
+    # ------------------------------------------------------------ reporting
+    def periodic_events(self, step: int) -> List[Event]:
+        """Scalar events for MonitorMaster at print boundaries: Goodput/*,
+        Memory/*, Compile/*."""
+        ev: List[Event] = []
+        if self.goodput is not None:
+            ev.extend(self.goodput.events(step))
+        snap = self.registry.snapshot()
+        g = snap["gauges"]
+        if "hbm_bytes_in_use" in g:
+            ev.append(("Memory/bytes_in_use", g["hbm_bytes_in_use"], step))
+            ev.append(("Memory/peak_bytes_in_use",
+                       g["hbm_peak_bytes_in_use"], step))
+        count, seconds = compile_stats()
+        ev.append(("Compile/count", count, step))
+        ev.append(("Compile/total_s", seconds, step))
+        if snap["counters"].get("ckpt_bytes_written"):
+            ev.append(("Ckpt/bytes_written",
+                       snap["counters"]["ckpt_bytes_written"], step))
+        ckpt_hist = snap["histograms"].get("ckpt_save_s")
+        if ckpt_hist and ckpt_hist["count"]:
+            ev.append(("Ckpt/save_s", ckpt_hist["sum"], step))
+        return ev
+
+    def dump(self, reason: str = "manual") -> List[Dict[str, Any]]:
+        """Force the ring (and a goodput summary) onto disk — called by the
+        preemption handler before the process exits."""
+        if self.goodput is not None:
+            self.recorder.record("goodput", "goodput/summary",
+                                 data=self.goodput.summary())
+        try:
+            from ..comm.comms_logging import comms_logger
+
+            if comms_logger.enabled:
+                self.recorder.record("event", "comm/snapshot",
+                                     data=comms_logger.snapshot())
+        except Exception:  # pragma: no cover - defensive
+            pass
+        records = self.recorder.dump(reason)
+        if self.jsonl is not None:
+            try:
+                self.jsonl.flush()
+            except Exception as e:
+                logger.warning("telemetry dump: jsonl flush failed: %s", e)
+        return records
+
+    def close(self, reason: str = "shutdown") -> None:
+        """Idempotent shutdown: final goodput summary + dump + sink flush."""
+        if self._closed:
+            return
+        self._closed = True
+        import atexit
+
+        try:  # py>=3.9: drop our strong atexit ref so closed telemetries
+            atexit.unregister(self.close)  # don't pin their rings for life
+        except Exception:  # pragma: no cover - defensive
+            pass
+        try:
+            self.dump(reason)
+        finally:
+            if get_active_recorder() is self.recorder:
+                set_active_recorder(None)
+
+
+def build_telemetry(config: Any, monitor: Any) -> Optional[Telemetry]:
+    """Engine-side factory: returns a wired :class:`Telemetry` or ``None``
+    when the ``telemetry`` config section is off (and ``DSTPU_TELEMETRY``
+    doesn't force it). Ensures a rank-local ``JsonlMonitor`` backend exists
+    on the given :class:`~.monitor.MonitorMaster` and attaches the flight
+    recorder to it."""
+    tcfg = config.telemetry
+    forced = os.environ.get("DSTPU_TELEMETRY", "").lower() in ("1", "true")
+    if not (tcfg.enabled or forced):
+        return None
+    import jax
+
+    from .monitor import JsonlMonitor
+
+    rank = jax.process_index()
+    jsonl = next((m for m in monitor.monitors
+                  if isinstance(m, JsonlMonitor)), None)
+    if jsonl is None:
+        jsonl = JsonlMonitor(
+            path=os.path.join(tcfg.output_dir,
+                              f"flightrec_rank{rank}.jsonl"),
+            flush_interval=tcfg.flush_interval_records)
+        monitor.monitors.append(jsonl)
+        monitor.enabled = True
+    return Telemetry(tcfg, jsonl=jsonl, rank=rank)

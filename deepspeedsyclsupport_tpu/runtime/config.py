@@ -263,8 +263,9 @@ class ActivationCheckpointingConfig:
 
 @dataclass
 class MonitorConfig:
-    """``tensorboard``/``wandb``/``csv_monitor`` sections (reference:
-    ``monitor/config.py``)."""
+    """``tensorboard``/``wandb``/``csv_monitor``/``jsonl_monitor`` sections
+    (reference: ``monitor/config.py``; jsonl is the rank-local flight-recorder
+    sink, see ``monitor/telemetry.py``)."""
     tensorboard_enabled: bool = False
     tensorboard_output_path: str = ""
     tensorboard_job_name: str = "DSTpuJobName"
@@ -275,12 +276,18 @@ class MonitorConfig:
     csv_enabled: bool = False
     csv_output_path: str = ""
     csv_job_name: str = "DSTpuJobName"
+    csv_flush_interval: int = 10  # write batches between csv flushes
+    jsonl_enabled: bool = False
+    jsonl_output_path: str = ""
+    jsonl_job_name: str = "DSTpuJobName"
+    jsonl_flush_interval: int = 64  # records buffered between jsonl flushes
 
     @classmethod
     def from_config_dict(cls, d: Dict[str, Any]) -> "MonitorConfig":
         tb = _sub(d, C.MONITOR_TENSORBOARD)
         wb = _sub(d, C.MONITOR_WANDB)
         csv = _sub(d, C.MONITOR_CSV)
+        jl = _sub(d, C.MONITOR_JSONL)
         return cls(
             tensorboard_enabled=bool(tb.get("enabled", False)),
             tensorboard_output_path=tb.get("output_path", ""),
@@ -292,11 +299,67 @@ class MonitorConfig:
             csv_enabled=bool(csv.get("enabled", False)),
             csv_output_path=csv.get("output_path", ""),
             csv_job_name=csv.get("job_name", "DSTpuJobName"),
+            csv_flush_interval=int(csv.get("flush_interval", 10)),
+            jsonl_enabled=bool(jl.get("enabled", False)),
+            jsonl_output_path=jl.get("output_path", ""),
+            jsonl_job_name=jl.get("job_name", "DSTpuJobName"),
+            jsonl_flush_interval=int(jl.get("flush_interval", 64)),
         )
 
     @property
     def enabled(self) -> bool:
-        return self.tensorboard_enabled or self.wandb_enabled or self.csv_enabled
+        return (self.tensorboard_enabled or self.wandb_enabled
+                or self.csv_enabled or self.jsonl_enabled)
+
+
+@dataclass
+class TelemetryConfig:
+    """``telemetry`` section — the structured observability layer
+    (``monitor/telemetry.py``): flight recorder + rank-local JSONL, goodput
+    accounting, recompile detection, HBM gauges, heartbeat file and
+    on-demand ``jax.profiler`` trace windows. ``DSTPU_TELEMETRY=1`` forces
+    ``enabled`` at runtime without a config edit."""
+    enabled: bool = False
+    output_dir: str = "telemetry_logs"
+    ring_size: int = 4096
+    flush_interval_records: int = 64
+    memory_interval_steps: int = 10
+    heartbeat_enabled: bool = True
+    heartbeat_interval_s: float = 1.0
+    stack_dump_on_hang: bool = True
+    goodput_enabled: bool = True
+    # block on the step's outputs before timing it: device-accurate step
+    # spans, at the cost of the host/device dispatch overlap
+    sync_timing: bool = False
+    trace_start_step: Optional[int] = None
+    trace_num_steps: int = 3
+    trace_dir: Optional[str] = None
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TelemetryConfig":
+        hb = dict(d.get("heartbeat", {}))
+        tr = dict(d.get("trace", {}))
+        ring = int(d.get("ring_size", 4096))
+        if ring <= 0:
+            raise ValueError(f"telemetry.ring_size must be > 0, got {ring}")
+        start = tr.get("start_step")
+        return cls(
+            enabled=bool(d.get("enabled", False)),
+            output_dir=str(d.get("output_dir", "telemetry_logs")),
+            ring_size=ring,
+            flush_interval_records=int(d.get("flush_interval_records", 64)),
+            memory_interval_steps=int(d.get("memory_interval_steps", 10)),
+            heartbeat_enabled=bool(hb.get("enabled", True)),
+            heartbeat_interval_s=float(hb.get("interval_s", 1.0)),
+            stack_dump_on_hang=bool(d.get("stack_dump_on_hang", True)),
+            sync_timing=bool(d.get("sync_timing", False)),
+            goodput_enabled=bool(d.get("goodput", {}).get("enabled", True)
+                                 if isinstance(d.get("goodput"), dict)
+                                 else d.get("goodput", True)),
+            trace_start_step=None if start is None else int(start),
+            trace_num_steps=int(tr.get("num_steps", 3)),
+            trace_dir=tr.get("trace_dir"),
+        )
 
 
 @dataclass
@@ -454,6 +517,7 @@ class DSTpuConfig:
     comms_logger: CommsLoggerConfig
     flops_profiler: FlopsProfilerConfig
     checkpoint: CheckpointConfig
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     progressive_layer_drop: ProgressiveLayerDropConfig = field(
         default_factory=ProgressiveLayerDropConfig)
     data_efficiency: DataEfficiencyConfig = field(
@@ -506,6 +570,7 @@ class DSTpuConfig:
             comms_logger=CommsLoggerConfig.from_dict(_sub(d, C.COMMS_LOGGER)),
             flops_profiler=FlopsProfilerConfig.from_dict(_sub(d, C.FLOPS_PROFILER)),
             checkpoint=CheckpointConfig.from_dict(_sub(d, C.CHECKPOINT)),
+            telemetry=TelemetryConfig.from_dict(_sub(d, C.TELEMETRY)),
             progressive_layer_drop=ProgressiveLayerDropConfig.from_dict(
                 _sub(d, "progressive_layer_drop")),
             data_efficiency=DataEfficiencyConfig.from_config_dict(d),

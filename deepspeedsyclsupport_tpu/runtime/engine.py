@@ -312,6 +312,14 @@ class Engine:
             batch_size=self.config.train_batch_size,
             steps_per_output=self.config.steps_per_print)
         self.monitor = MonitorMaster(self.config.monitor)
+        # Structured observability spine (monitor/telemetry.py): flight
+        # recorder ring + rank-local JSONL, goodput accounting, recompile
+        # detection, HBM gauges, heartbeat. None when the telemetry section
+        # is off and DSTPU_TELEMETRY doesn't force it — the per-step guards
+        # below then cost one attribute check.
+        from ..monitor.telemetry import build_telemetry
+
+        self.telemetry = build_telemetry(self.config, self.monitor)
 
         # -------------------------------------------- activation checkpointing
         # (reference runtime/activation_checkpointing/: config-driven
@@ -432,6 +440,28 @@ class Engine:
         # {"jax_profiler": {"enabled": true, "trace_dir": ..., "start_step":
         # N, "num_steps": M}} brackets M train steps with a device trace
         jp = dict(self.config.raw.get("jax_profiler", {}))
+        tcfg = self.config.telemetry
+        if tcfg.trace_start_step is not None and \
+                (tcfg.enabled or self.telemetry is not None):
+            # telemetry.trace is the newer spelling of the same window knobs
+            jp = {"enabled": True, "start_step": tcfg.trace_start_step,
+                  "num_steps": tcfg.trace_num_steps,
+                  "trace_dir": tcfg.trace_dir or jp.get("trace_dir")}
+        env_start = os.environ.get("DSTPU_TRACE_START_STEP")
+        if env_start:
+            # env-triggered trace window: profile a misbehaving production
+            # run without touching its config. A malformed value must not
+            # kill the run the operator is trying to observe.
+            try:
+                jp = {"enabled": True, "start_step": int(env_start),
+                      "num_steps": int(os.environ.get(
+                          "DSTPU_TRACE_NUM_STEPS", jp.get("num_steps", 3))),
+                      "trace_dir": (os.environ.get("DSTPU_TRACE_DIR")
+                                    or jp.get("trace_dir"))}
+            except ValueError as e:
+                logger.warning(
+                    "ignoring malformed DSTPU_TRACE_START_STEP/"
+                    "DSTPU_TRACE_NUM_STEPS (%s); no trace window armed", e)
         self._trace_cfg = jp if jp.get("enabled") else None
         self._tracing = False
         self._trace_origin = None  # "config" windows auto-stop; manual don't
@@ -878,8 +908,19 @@ class Engine:
             jax.block_until_ready(metrics["loss"])
             comms_logger.record_wall("train_batch",
                                      time.perf_counter() - t_step)
+        elif self.telemetry is not None and self.telemetry.cfg.sync_timing:
+            # telemetry.sync_timing: device-accurate step spans — trades the
+            # dispatch/compute overlap for timing fidelity (see on_step_end)
+            jax.block_until_ready(metrics["loss"])
+        step_dur = time.perf_counter() - t_step
         self.global_steps += 1
         self.micro_steps += gas
+        if self.telemetry is not None:
+            # step span + recompile attribution + goodput + heartbeat +
+            # periodic HBM gauges — a few host dict appends (<5% guarded by
+            # tests/unit/test_telemetry.py::test_telemetry_overhead)
+            self.telemetry.on_step_end(self.global_steps, step_dur,
+                                       batch=batch)
         if self._tracing and self._trace_origin == "config":
             start = int(self._trace_cfg.get("start_step", 1))
             n = int(self._trace_cfg.get("num_steps", 3))
@@ -1018,6 +1059,9 @@ class Engine:
             self._accum_grads, self._accum_count = None, 0
             self._accum_losses = []
             self.global_steps += 1
+            if self.telemetry is not None:
+                # eager-path step span: boundary-to-boundary wall (dur=None)
+                self.telemetry.on_step_end(self.global_steps)
             self._post_step(metrics)
             return metrics
         if self._apply_fn is None:
@@ -1043,6 +1087,11 @@ class Engine:
         self._accum_count = 0
         self._accum_losses = []
         self.global_steps += 1
+        if self.telemetry is not None:
+            # eager-path step span: boundary-to-boundary wall (dur=None) —
+            # includes data/host time between steps, unlike the fused path's
+            # measured step_dur
+            self.telemetry.on_step_end(self.global_steps)
         self._post_step(metrics)
         return metrics
 
@@ -1106,6 +1155,11 @@ class Engine:
             if value and value != self._resilience_reported.get(name):
                 self._resilience_reported[name] = value
                 events.append((f"Resilience/{name}", value, samples))
+        if self.telemetry is not None:
+            # Goodput/*, Memory/*, Compile/*, Ckpt/* at every print boundary
+            events.extend(self.telemetry.periodic_events(samples))
+        if comms_logger.enabled:
+            events.extend(comms_logger.summary_events(samples))
         if events:
             self.monitor.write_events(events)
 
@@ -1167,6 +1221,16 @@ class Engine:
         here one orbax sharded tree serves all topologies), through the
         configured checkpoint engine (sync native, or the async Nebula-analog
         that returns after the host snapshot)."""
+        if self.telemetry is not None:
+            with self.telemetry.ckpt_span("save", step=self.global_steps):
+                return self._save_checkpoint_impl(save_dir, tag, client_state,
+                                                  save_latest)
+        return self._save_checkpoint_impl(save_dir, tag, client_state,
+                                          save_latest)
+
+    def _save_checkpoint_impl(self, save_dir: str, tag: Optional[str],
+                              client_state: Optional[Dict],
+                              save_latest: bool) -> str:
         tag = tag or f"global_step{self.global_steps}"
         self._validate_tag(tag)
         path = os.path.join(save_dir, tag)

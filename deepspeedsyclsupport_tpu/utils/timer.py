@@ -44,6 +44,13 @@ class _Timer:
         if record:
             self._record.append(delta)
         self.started = False
+        # re-pointed island: timer intervals land in the flight recorder ring
+        # (when one is active) so the step timeline shows fwd/bwd/step spans
+        from ..monitor.telemetry import get_active_recorder
+
+        rec = get_active_recorder()
+        if rec is not None:
+            rec.record("span", f"timer/{self.name}", dur=delta)
 
     def reset(self):
         self._start = None
@@ -51,11 +58,16 @@ class _Timer:
         self.started = False
 
     def elapsed(self, reset: bool = True) -> float:
+        now = time.time()
         value = self._elapsed
         if self.started:
-            value += time.time() - self._start
+            value += now - self._start
         if reset:
             self._elapsed = 0.0
+            if self.started:
+                # rebase a running timer: without this the interval just
+                # reported would be re-added by the subsequent stop()
+                self._start = now
         return value
 
     def mean(self) -> float:

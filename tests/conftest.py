@@ -15,6 +15,10 @@ if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
+# Event-name guard (monitor/telemetry.py): under the suite every event
+# emitted through MonitorMaster must be declared in the registry — a typo'd
+# metric name raises instead of silently forking a new CSV file.
+os.environ.setdefault("DSTPU_STRICT_EVENTS", "1")
 
 import jax  # noqa: E402
 

@@ -3,7 +3,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.5: the experimental spelling (or opt into the
+    # modern surface process-wide with DSTPU_JAX_COMPAT=1 — utils/jax_compat)
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
 import deepspeedsyclsupport_tpu.comm as dist
@@ -144,8 +148,8 @@ class TestHierarchicalAllToAll:
 
         kw = dict(mesh=topo.mesh, in_specs=P("data"), out_specs=P("data"),
                   check_vma=False)
-        a = jax.shard_map(flat, **kw)(x)
-        b = jax.shard_map(hier, **kw)(x)
+        a = shard_map(flat, **kw)(x)
+        b = shard_map(hier, **kw)(x)
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
     def test_same_axes_roundtrip(self, mesh8):
@@ -164,7 +168,7 @@ class TestHierarchicalAllToAll:
             return dist.hierarchical_all_to_all(y, "data", 4, split_axis=0,
                                                 concat_axis=1)
 
-        out = jax.shard_map(rt, mesh=topo.mesh, in_specs=P("data"),
+        out = shard_map(rt, mesh=topo.mesh, in_specs=P("data"),
                             out_specs=P("data"), check_vma=False)(x)
         np.testing.assert_allclose(np.asarray(out), np.asarray(x),
                                    rtol=1e-6)
@@ -178,7 +182,7 @@ class TestHierarchicalAllToAll:
         topo = mesh8
         x = jnp.ones((8, 8))
         with pytest.raises(ValueError):
-            jax.shard_map(
+            shard_map(
                 lambda v: dist.hierarchical_all_to_all(v, "data", 3,
                                                        split_axis=1),
                 mesh=topo.mesh, in_specs=P("data"), out_specs=P("data"),
@@ -194,7 +198,7 @@ class TestReferenceSurfaceParity:
         from jax.sharding import PartitionSpec as P
 
         topo = ds.build_topology(dp=n)
-        return np.asarray(jax.jit(jax.shard_map(
+        return np.asarray(jax.jit(shard_map(
             fn, mesh=topo.mesh, in_specs=P("data"), out_specs=P("data"),
             check_vma=False))(x))
 
@@ -212,7 +216,7 @@ class TestReferenceSurfaceParity:
         topo = ds.build_topology(dp=8)
         # every rank holds an [8]-chunk; src's chunks get scattered
         x = jnp.arange(64.0).reshape(8, 8)
-        out = np.asarray(jax.jit(jax.shard_map(
+        out = np.asarray(jax.jit(shard_map(
             lambda v: dist.scatter(v[0], "data", src=2)[None, None],
             mesh=topo.mesh, in_specs=P("data"), out_specs=P("data"),
             check_vma=False))(x))
@@ -244,3 +248,71 @@ class TestReferenceSurfaceParity:
         assert dist.get_world_group().size() == dist.get_device_count()
         with pytest.raises(TypeError):
             dist.get_global_rank("model", 1)  # mesh axes need coordinates
+
+
+# =============================================== comms logger summary paths
+class TestCommsLoggerSummary:
+    """Tier-1 coverage for the straggler table and HLO-merge idempotency
+    (ISSUE 4 satellite: these paths previously had no tests)."""
+
+    def _fresh(self):
+        from deepspeedsyclsupport_tpu.comm.comms_logging import CommsLogger
+
+        lg = CommsLogger(enabled=True)
+        lg.append("all_reduce", "data", 1024, (8,))
+        lg.append("all_reduce", "data", 1024, (8,))
+        lg.append("all_gather", "fsdp", 2048, (16,))
+        return lg
+
+    def test_log_summary_straggler_single_process(self):
+        lg = self._fresh()
+        lg.record_wall("train_batch", 1.5)
+        lg.record_wall("ckpt", 0.25)
+        table = lg.log_summary(show_straggler=True)
+        assert "wall-clock (per host)" in table
+        # single controller: self == min == max on every row
+        for name, want in (("train_batch", "1.500"), ("ckpt", "0.250")):
+            row = next(l for l in table.splitlines() if l.startswith(name))
+            assert row.count(want) == 3, row
+
+    def test_log_summary_without_straggler_omits_wall(self):
+        lg = self._fresh()
+        lg.record_wall("train_batch", 1.0)
+        table = lg.log_summary(show_straggler=False)
+        assert "wall-clock" not in table
+        assert "all_reduce[data]" in table
+
+    def test_record_hlo_idempotent(self):
+        lg = self._fresh()
+        hlo = {"all-reduce": {"count": 3, "total_bytes": 300},
+               "all-gather": {"count": 1, "total_bytes": 100}}
+        lg.record_hlo(hlo, tag="train_step")
+        lg.record_hlo(hlo, tag="train_step")  # re-record: replace, not add
+        snap = lg.snapshot()
+        assert snap["xla::all-reduce[train_step]"] == {"count": 3,
+                                                       "total_bytes": 300}
+        assert snap["xla::all-gather[train_step]"] == {"count": 1,
+                                                       "total_bytes": 100}
+        # a different tag is a different program: separate keys
+        lg.record_hlo(hlo, tag="eval_step")
+        assert "xla::all-reduce[eval_step]" in lg.snapshot()
+        # façade-recorded ops are untouched by the merge
+        assert lg.snapshot()["all_reduce[data]"]["count"] == 2
+
+    def test_summary_events_sanitized_and_declared(self):
+        from deepspeedsyclsupport_tpu.monitor.telemetry import (
+            EVENT_NAME_RE, is_declared)
+
+        lg = self._fresh()
+        lg.record_hlo({"all-reduce": {"count": 1, "total_bytes": 10}},
+                      tag="train_step")
+        events = lg.summary_events(step=7)
+        assert events
+        for name, value, step in events:
+            assert step == 7
+            assert name.startswith("Comm/")
+            assert EVENT_NAME_RE.match(name), name
+            assert is_declared(name), name
+        named = dict((n, v) for n, v, _ in events)
+        assert named["Comm/all_reduce.data/count"] == 2
+        assert named["Comm/all_reduce.data/bytes"] == 2048

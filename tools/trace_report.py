@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""Offline flight-recorder report: step timeline, goodput split, stragglers.
+
+Renders the rank-local JSONL stream written by the telemetry layer
+(``deepspeedsyclsupport_tpu/monitor/telemetry.py`` flight recorder +
+``monitor/monitor.py::JsonlMonitor``) into the summary an operator wants
+after a preemption storm — no devices, no jax session, safe on a login node.
+
+Usage::
+
+    python tools/trace_report.py telemetry_logs/flightrec_rank0.jsonl
+    python tools/trace_report.py logs/flightrec_rank*.jsonl --last 30
+
+With several rank files the report adds a straggler section comparing each
+host's accumulated step wall-clock (the SPMD analog of per-rank collective
+latency — a host far above the minimum is the straggler).
+
+Exit code 0 on success, 2 when no input file yields any records.
+"""
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+#: A goodput split must account for at least this fraction of wall-clock —
+#: the accounter computes ``other`` as the residual, so anything below this
+#: indicates a truncated/corrupt log rather than rounding.
+ACCOUNTING_FLOOR = 0.99
+
+
+def load_records(path: str) -> List[Dict[str, Any]]:
+    records = []
+    try:
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except ValueError:
+                    # a torn final line is EXPECTED for a crash dump —
+                    # everything before it is still good
+                    print(f"  note: {path}:{lineno}: torn/unparsable line "
+                          f"skipped", file=sys.stderr)
+    except OSError as e:
+        print(f"error: cannot read {path}: {e}", file=sys.stderr)
+    return records
+
+
+def _fmt_s(sec: float) -> str:
+    return f"{sec * 1000:.1f}ms" if sec < 1.0 else f"{sec:.2f}s"
+
+
+def step_timeline(records: List[Dict[str, Any]], last: int) -> List[str]:
+    steps = [r for r in records
+             if r.get("kind") == "span" and r.get("name") == "step"]
+    lines = [f"step timeline (last {min(last, len(steps))} of {len(steps)} "
+             f"recorded steps)",
+             f"{'step':>8}{'duration':>12}{'compiles':>10}  notes"]
+    for r in steps[-last:]:
+        data = r.get("data") or {}
+        notes = ""
+        if data.get("compiles"):
+            notes = (f"recompile x{data['compiles']} "
+                     f"({_fmt_s(data.get('compile_s', 0.0))})")
+        lines.append(f"{r.get('step', '?'):>8}{_fmt_s(r.get('dur', 0.0)):>12}"
+                     f"{data.get('compiles', 0):>10}  {notes}")
+    if not steps:
+        lines.append("  (no step spans recorded)")
+    return lines
+
+
+def goodput_summary(records: List[Dict[str, Any]]) -> List[str]:
+    summaries = [r for r in records if r.get("kind") == "goodput"]
+    lines = ["goodput"]
+    if not summaries:
+        lines.append("  (no goodput summary — telemetry.goodput disabled or "
+                     "log truncated before the first dump)")
+        return lines
+    s = summaries[-1].get("data") or {}
+    total = float(s.get("total", 0.0)) or 1e-9
+    cats = [k for k in ("productive", "checkpoint", "compile", "startup",
+                        "other") if k in s]
+    accounted = sum(float(s[c]) for c in cats)
+    for c in cats:
+        v = float(s[c])
+        lines.append(f"  {c:<12}{_fmt_s(v):>12}  {100.0 * v / total:6.2f}%")
+    lines.append(f"  {'total':<12}{_fmt_s(total):>12}")
+    frac = accounted / total
+    lines.append(f"  accounted: {100.0 * frac:.2f}% of wall-clock"
+                 + ("" if frac >= ACCOUNTING_FLOOR else
+                    f"  <-- BELOW {ACCOUNTING_FLOOR:.0%}: log is truncated "
+                    f"or the accounter is broken"))
+    lines.append(f"  productive fraction: "
+                 f"{100.0 * float(s.get('productive_frac', 0.0)):.2f}%")
+    return lines
+
+
+def events_summary(records: List[Dict[str, Any]]) -> List[str]:
+    lines = ["notable events"]
+    compiles = [r for r in records if r.get("kind") == "event"
+                and r.get("name") == "compile/train_step"]
+    for r in compiles[-5:]:
+        diff = (r.get("data") or {}).get("shape_diff", {})
+        what = ("initial compile" if diff.get("initial")
+                else f"shape diff: {json.dumps(diff)[:120]}")
+        lines.append(f"  step {r.get('step', '?')}: recompile "
+                     f"({_fmt_s(r.get('dur', 0.0))}) — {what}")
+    dumps = [r for r in records if r.get("kind") == "dump"]
+    for r in dumps:
+        reason = (r.get("data") or {}).get("reason", "?")
+        lines.append(f"  dump: reason={reason}")
+        res = (r.get("data") or {}).get("resilience", {})
+        nonzero = {k: v for k, v in res.items() if v}
+        if nonzero:
+            lines.append(f"    resilience counters: {nonzero}")
+    mems = [r for r in records if r.get("kind") == "gauge"
+            and r.get("name") == "memory/hbm"]
+    if mems:
+        peak = max(int((r.get("data") or {}).get("peak_bytes_in_use", 0))
+                   for r in mems)
+        lines.append(f"  peak HBM: {peak / 2**30:.2f} GiB")
+    metrics: Dict[str, Any] = {}
+    for r in records:
+        if r.get("kind") == "metric":
+            metrics[r["name"]] = r.get("value")
+    if metrics:
+        lines.append("  last metric values:")
+        for name in sorted(metrics):
+            lines.append(f"    {name} = {metrics[name]}")
+    if len(lines) == 1:
+        lines.append("  (none)")
+    return lines
+
+
+def straggler_summary(per_rank: Dict[str, List[Dict[str, Any]]]) -> List[str]:
+    lines = ["stragglers (per-host accumulated step wall-clock)"]
+    totals = {}
+    for path, records in per_rank.items():
+        tot = sum(r.get("dur", 0.0) for r in records
+                  if r.get("kind") == "span" and r.get("name") == "step")
+        meta = next((r for r in records if r.get("kind") == "meta"), {})
+        rank = (meta.get("data") or {}).get("rank", path)
+        totals[f"rank{rank}"] = tot
+    if not totals:
+        lines.append("  (no step spans)")
+        return lines
+    lo = min(totals.values())
+    for name in sorted(totals):
+        tot = totals[name]
+        flag = "  <-- straggler" if lo > 0 and tot > 1.2 * lo else ""
+        lines.append(f"  {name:<10}{_fmt_s(tot):>12}{flag}")
+    return lines
+
+
+def render(paths: List[str], last: int = 20) -> Optional[str]:
+    per_rank = {p: load_records(p) for p in paths}
+    per_rank = {p: r for p, r in per_rank.items() if r}
+    if not per_rank:
+        return None
+    first = per_rank[next(iter(per_rank))]
+    out: List[str] = []
+    n_total = sum(len(r) for r in per_rank.values())
+    out.append(f"flight recorder report — {len(per_rank)} file(s), "
+               f"{n_total} records")
+    times = [r["t"] for r in first if "t" in r]
+    if times:
+        out.append(f"wall span: {max(times) - min(times):.2f}s "
+                   f"({len(first)} records in {next(iter(per_rank))})")
+    out.append("")
+    out.extend(step_timeline(first, last))
+    out.append("")
+    out.extend(goodput_summary(first))
+    out.append("")
+    out.extend(events_summary(first))
+    if len(per_rank) > 1:
+        out.append("")
+        out.extend(straggler_summary(per_rank))
+    return "\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Render a flight-recorder JSONL into a step-timeline / "
+                    "goodput / straggler summary.")
+    ap.add_argument("files", nargs="+",
+                    help="flight-recorder JSONL file(s), one per rank")
+    ap.add_argument("--last", type=int, default=20,
+                    help="how many trailing steps to show in the timeline")
+    args = ap.parse_args(argv)
+    report = render([os.path.expanduser(p) for p in args.files],
+                    last=args.last)
+    if report is None:
+        print("no records found in any input file", file=sys.stderr)
+        return 2
+    print(report)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
