@@ -29,6 +29,11 @@ import subprocess
 import sys
 import time
 
+# the model stack uses modern jax spellings; on an older jax the opt-in
+# compat shims (utils/jax_compat.py) graft them on. Must be set before any
+# deepspeedsyclsupport_tpu import (children import inside their rung fns).
+os.environ.setdefault("DSTPU_JAX_COMPAT", "1")
+
 # bf16 peak FLOPs and HBM bandwidth by platform (per chip)
 PEAKS = {"tpu": 197e12,   # TPU v5e
          "cpu": 1e12}     # nominal, for smoke runs off-TPU
@@ -40,6 +45,13 @@ RUNG_ENV = "DSTPU_BENCH_RUNG"
 
 def _emit(result):
     print(json.dumps(result), flush=True)
+
+
+class _ScenarioTimeout(RuntimeError):
+    """A single scenario (one load point / A-B arm) overran its budget.
+    Raised from inside the driving loop so the caller can flush whatever
+    the sweep completed so far instead of losing the whole rung (the r05
+    rc=124 failure mode: the bench died with everything buffered)."""
 
 
 def _attn_overrides(attn):
@@ -395,6 +407,12 @@ def _measure(name, seq, micro_bs, steps, remat, platform,
         "train_micro_batch_size_per_gpu": micro_bs,
         "optimizer": {"type": "adam", "params": {"lr": 1e-4}},
         "bf16": {"enabled": True},
+        # the ROADMAP MFU levers, explicit in the ENGINE config (not just
+        # the model flag): remat via the activation_checkpointing section;
+        # buffer donation is the fused train path's default and is VERIFIED
+        # below by the analysis donation audit — a missed donation is a
+        # silent HBM doubling that shrinks the ladder's feasible rungs
+        "activation_checkpointing": {"enabled": remat},
         "steps_per_print": 10_000,
     }
     engine, _, _, _ = ds.initialize(model=model, config=config, topology=topo)
@@ -417,6 +435,16 @@ def _measure(name, seq, micro_bs, steps, remat, platform,
     flops_per_token = f_matmul + f_attn * seq
     achieved = tok_per_sec * flops_per_token
     mfu = achieved / PEAKS.get(platform, PEAKS["cpu"])
+    # donation audit (analysis/donation.py) on the exact compiled step we
+    # just timed — re-lowering is a compile-cache hit. Outside the timed
+    # window; best-effort (the bench contract: never die on telemetry).
+    try:
+        rep = engine.graph_report(analyzers=("donation",))["donation"]
+        donation = {"ok": rep.ok, "donated": len(rep.donated),
+                    "missed": len(rep.not_donated),
+                    "wasted_bytes": rep.wasted_bytes}
+    except Exception as e:
+        donation = {"ok": None, "error": str(e)[:200]}
     return {
         "metric": f"train_tokens_per_sec_per_chip_{name}_seq{seq}",
         "value": round(tok_per_sec, 1),
@@ -425,6 +453,7 @@ def _measure(name, seq, micro_bs, steps, remat, platform,
         "detail": {"platform": platform, "mfu": round(mfu, 4),
                    "tflops": round(achieved / 1e12, 2),
                    "micro_bs": micro_bs, "remat": remat,
+                   "donation": donation,
                    "attn_impl": attn_impl,
                    "baseline": "achieved MFU vs reference 54% (Ulysses "
                                "175/312 TFLOPs on A100)",
@@ -521,7 +550,7 @@ def run_train():
 # rung: serve (FastGen-style TTFT / throughput, SplitFuse A-B)
 # ======================================================================
 def _drive_serving(eng, prompts, n_clients, reqs_per_client, gen_len, mode,
-                   uid_base, arrival_of=None):
+                   uid_base, arrival_of=None, deadline=None):
     """Closed-loop clients over the v2 engine at single-forward granularity.
 
     mode="splitfuse": decode tokens and (chunked) prompt tokens fuse into
@@ -536,6 +565,10 @@ def _drive_serving(eng, prompts, n_clients, reqs_per_client, gen_len, mode,
     arm batch every prefill upfront and never preempt a decode, which is
     not the scenario the SplitFuse claim is about). A request's TTFT clock
     starts at its arrival.
+
+    ``deadline`` (``time.perf_counter()`` base): overrunning it raises
+    :class:`_ScenarioTimeout` so the caller keeps earlier scenarios'
+    results instead of losing the whole rung to one slow load point.
     """
     import jax.numpy as jnp
 
@@ -590,6 +623,10 @@ def _drive_serving(eng, prompts, n_clients, reqs_per_client, gen_len, mode,
         submit(c, t0)
     while finished < total:
         now = time.perf_counter()
+        if deadline is not None and now > deadline:
+            raise _ScenarioTimeout(
+                f"{mode}: scenario deadline after {finished}/{total} "
+                f"requests ({total_decoded} tokens)")
         # prompts first in naive mode: they preempt and fully prefill
         if mode == "naive" and waiting:
             admit_u, admit_t = [], []
@@ -678,7 +715,9 @@ def _drive_serving(eng, prompts, n_clients, reqs_per_client, gen_len, mode,
             future = [submitted[u] for u, _ in waiting
                       if not arrived(u, now)]
             if future and not live:
-                time.sleep(max(0.0, min(future) - time.perf_counter()))
+                wake = min(future) if deadline is None \
+                    else min(min(future), deadline)
+                time.sleep(max(0.0, wake - time.perf_counter()))
                 stall_guard = 0
                 continue
             stall_guard += 1
@@ -738,7 +777,7 @@ def _drive_serving(eng, prompts, n_clients, reqs_per_client, gen_len, mode,
 
 def _serve_once(model_name, platform, *, n_clients, reqs_per_client,
                 prompt_len, gen_len, budget, block_size, max_context,
-                attn=None):
+                attn=None, scenario_budget_s=None):
     import jax
 
     from deepspeedsyclsupport_tpu.inference.v2 import InferenceEngineV2
@@ -779,11 +818,21 @@ def _serve_once(model_name, platform, *, n_clients, reqs_per_client,
         for c in range(n_clients):
             for r in range(reqs_per_client):
                 prompts[uid_base + c * 1000 + r] = mk_prompt()
+        deadline = (time.perf_counter() + scenario_budget_s
+                    if scenario_budget_s else None)
         results[mode] = _drive_serving(eng, prompts, n_clients,
                                        reqs_per_client, gen_len, mode,
-                                       uid_base)
-    for r in results.values():
-        r.pop("req_stats", None)  # raw per-request rows are goodput-rung fuel
+                                       uid_base, deadline=deadline)
+        results[mode].pop("req_stats", None)  # per-request rows are
+        # goodput-rung fuel, not serve-line payload
+        # flush the completed arm NOW: if the other arm hangs/overruns,
+        # the parent's partial-stdout parse still banks this measurement
+        _emit({"metric": f"serve_arm_{mode}_{model_name}",
+               "value": results[mode]["throughput_tok_s"],
+               "unit": "tokens/s", "vs_baseline": 0.0,
+               "detail": {"platform": platform, "partial": True,
+                          "mode": mode, "clients": n_clients,
+                          **results[mode]}})
     speedup = (results["splitfuse"]["throughput_tok_s"]
                / max(results["naive"]["throughput_tok_s"], 1e-9))
     sf = results["splitfuse"]
@@ -832,11 +881,18 @@ def _goodput(req_stats, sla_rate, ttft_sla, wall):
 
 def _serve_goodput_once(model_name, platform, *, client_sweep,
                         reqs_per_client, prompt_len, gen_len, budget,
-                        block_size, max_context, attn=None):
+                        block_size, max_context, attn=None,
+                        sweep_budget_s=None):
     """Load sweep: closed-loop clients at increasing counts; SLA is a
     per-client token rate calibrated to 50% of the solo (1-client) decode
     rate — the blog's 'effective throughput under a latency SLA' shape.
-    SplitFuse and naive run the SAME work at each load point."""
+    SplitFuse and naive run the SAME work at each load point.
+
+    Per-scenario timeout (the r05 rc=124 fix): each completed load point is
+    flushed as a partial JSON line the moment it finishes, every arm runs
+    under a deadline carved from ``sweep_budget_s``, and a timed-out arm
+    ends the sweep with the completed points reported — a sweep that dies
+    at 10 clients still banks the 4- and 6-client measurements."""
     import jax
     import numpy as np
 
@@ -865,12 +921,17 @@ def _serve_goodput_once(model_name, platform, *, client_sweep,
                 for c in range(n_clients) for r in range(reqs_per_client)}
 
     eng.warmup()
+    # ONE deadline covers calibration + sweep: the budget bounds the whole
+    # call, not each phase separately
+    sweep_end = (time.perf_counter() + sweep_budget_s
+                 if sweep_budget_s else None)
     # SLA calibration: solo client, splitfuse arm — median ITL sets the
     # unloaded decode rate (SLA demands half of it, queue excluded), solo
     # TTFT sets the first-token bound (SLA allows 3x: queueing headroom,
     # the blog's latency-SLA shape)
     solo = _drive_serving(eng, prompts_for(9_000_000, 1), 1, 1,
-                          gen_len, "splitfuse", 9_000_000)
+                          gen_len, "splitfuse", 9_000_000,
+                          deadline=sweep_end)
     solo_rate = 1.0 / max(solo["itl_p50_s"], 1e-6)
     sla_rate = 0.5 * solo_rate
     # TTFT bound stays loose (5x solo): the discriminating bound is the
@@ -884,17 +945,27 @@ def _serve_goodput_once(model_name, platform, *, client_sweep,
     solo_span = solo["ttft_p50_s"] + gen_len * solo["itl_p50_s"]
 
     points = []
+    skipped = []
     best = None
     for li, n_clients in enumerate(client_sweep):
         point = {"clients": n_clients, "sla_tok_s": round(sla_rate, 2),
                  "sla_ttft_s": round(ttft_sla, 3)}
+        timed_out = None
         for mi, mode in enumerate(("naive", "splitfuse")):
+            if sweep_end is not None and time.perf_counter() > sweep_end:
+                timed_out = f"{mode}: sweep budget exhausted before start"
+                break
             uid_base = (li * 2 + mi + 1) * 1_000_000
             arrivals = {uid_base + c * 1000 + 0: c * solo_span / n_clients
                         for c in range(n_clients)}
-            r = _drive_serving(eng, prompts_for(uid_base, n_clients),
-                               n_clients, reqs_per_client, gen_len, mode,
-                               uid_base, arrival_of=arrivals)
+            try:
+                r = _drive_serving(eng, prompts_for(uid_base, n_clients),
+                                   n_clients, reqs_per_client, gen_len, mode,
+                                   uid_base, arrival_of=arrivals,
+                                   deadline=sweep_end)
+            except _ScenarioTimeout as e:
+                timed_out = str(e)
+                break
             gp, miss = _goodput(r.pop("req_stats"), sla_rate, ttft_sla,
                                 r["wall_s"])
             point[mode] = {"goodput_tok_s": round(gp, 2),
@@ -907,13 +978,34 @@ def _serve_goodput_once(model_name, platform, *, client_sweep,
                            "itl_std_s": r["itl_std_s"],
                            "host_dispatches_per_token":
                                r["host_dispatches_per_token"]}
+        if timed_out is not None:
+            # the remaining (heavier) load points would also overrun:
+            # stop the sweep, keep what completed
+            skipped.append({"clients": n_clients, "reason": timed_out})
+            skipped.extend({"clients": c, "reason": "after timeout"}
+                           for c in client_sweep[li + 1:])
+            print(f"serve_goodput: load point {n_clients} timed out "
+                  f"({timed_out}); reporting {len(points)} completed "
+                  f"point(s)", file=sys.stderr)
+            break
         ratio = (point["splitfuse"]["goodput_tok_s"]
                  / max(point["naive"]["goodput_tok_s"], 1e-9))
         point["goodput_ratio"] = round(ratio, 3)
         points.append(point)
+        # flush the completed point NOW (partial line): a later kill —
+        # SIGTERM, rc=124, a hung arm — cannot take it back
+        _emit({"metric": f"serve_goodput_point_{model_name}",
+               "value": point["splitfuse"]["goodput_tok_s"],
+               "unit": "tokens/s", "vs_baseline": 0.0,
+               "detail": {"platform": platform, "partial": True,
+                          "point": point}})
         if best is None or ratio > best[1]:
             best = (n_clients, ratio, point)
 
+    if best is None:
+        raise RuntimeError(
+            f"serve_goodput: no load point completed inside the sweep "
+            f"budget ({sweep_budget_s}s); skipped={skipped}")
     return {
         "metric": f"serve_goodput_sla_{model_name}",
         "value": best[2]["splitfuse"]["goodput_tok_s"],
@@ -928,6 +1020,7 @@ def _serve_goodput_once(model_name, platform, *, client_sweep,
                    "best_load_point_clients": best[0],
                    "best_goodput_ratio_splitfuse_vs_naive": round(best[1], 3),
                    "load_sweep": points,
+                   "load_points_skipped": skipped,
                    "baseline": "SplitFuse-vs-naive goodput ratio at the "
                                "best load point vs the reference FastGen "
                                "2.3x effective-throughput headline"},
@@ -965,10 +1058,19 @@ def run_serve_goodput():
                  reqs_per_client=1, prompt_len=512, gen_len=64, budget=96,
                  block_size=32, max_context=1024),
         ]
+    # ONE budget for the whole rung, carved across ladder retries (see
+    # run_serve); each config's sweep gets what the earlier ones left
+    rung_end = time.monotonic() + float(
+        os.environ.get("DSTPU_GOODPUT_SWEEP_BUDGET", 540))
     last_err = None
     for cfg in ladder:
+        remaining = rung_end - time.monotonic()
+        if remaining < 60:
+            last_err = f"{cfg['model_name']}: skipped (rung budget)"
+            break
         try:
-            _emit(_serve_goodput_once(platform=platform, **cfg))
+            _emit(_serve_goodput_once(platform=platform,
+                                      sweep_budget_s=remaining, **cfg))
             return
         except Exception as e:
             last_err = (f"{cfg['model_name']}[{cfg.get('attn') or 'auto'}]: "
@@ -1211,10 +1313,21 @@ def run_serve():
                  prompt_len=48, gen_len=12, budget=64, block_size=16,
                  max_context=128),
         ]
+    # ONE budget for the whole rung, carved across ladder retries — a
+    # fresh per-config budget could legally outlive the parent's _spawn
+    # timeout and turn back into the buffered-results kill this fixes
+    rung_end = time.monotonic() + float(
+        os.environ.get("DSTPU_SERVE_RUNG_BUDGET", 400))
     last_err = None
     for cfg in ladder:
+        remaining = rung_end - time.monotonic()
+        if remaining < 30:
+            last_err = f"{cfg['model_name']}: skipped (rung budget)"
+            break
         try:
-            _emit(_serve_once(platform=platform, **cfg))
+            _emit(_serve_once(platform=platform,
+                              scenario_budget_s=remaining / 2,  # two arms
+                              **cfg))
             return
         except Exception as e:
             last_err = (f"{cfg['model_name']}[{cfg.get('attn') or 'auto'}]: "
@@ -1246,26 +1359,55 @@ def _spawn(rung, timeout, env_overrides):
     env = dict(os.environ)
     env[RUNG_ENV] = rung
     env.update(env_overrides)
+    # Popen + communicate instead of subprocess.run: run() handles ONLY
+    # TimeoutExpired with output capture — any other exception (the
+    # SIGTERM handler's _Killed, notably) kills the child and closes the
+    # pipes without draining them, losing every partial line the child
+    # already flushed. The kill path below drains first and hangs the
+    # salvaged results on the exception for main()'s aggregate flush.
+    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True, env=env)
     try:
-        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                              capture_output=True, text=True, timeout=timeout,
-                              env=env)
-    except subprocess.TimeoutExpired as e:
-        out = e.stdout
-        if isinstance(out, bytes):
-            out = out.decode("utf-8", "replace")
-        return _parse_lines(out), f"{rung}: timeout after {timeout}s"
-    results = _parse_lines(proc.stdout)
+        out, err_txt = proc.communicate(timeout=timeout)
+    except BaseException as exc:
+        proc.kill()
+        try:
+            out, _ = proc.communicate(timeout=10)
+        except _Killed as killed:
+            # SIGTERM landed during the drain itself (e.g. while handling
+            # a rung timeout): the kill outranks whatever got us here — it
+            # must reach main()'s aggregate flush, not be swallowed. The
+            # child is already SIGKILLed, so one bounded retry recovers the
+            # pipe content (communicate() keeps partial output across an
+            # interrupted call and allows retrying).
+            try:
+                out, _ = proc.communicate(timeout=2)
+            except BaseException:
+                out = ""
+            killed.results = _parse_lines(out)
+            killed.rung = rung
+            raise
+        except BaseException:
+            out = ""
+        results = _parse_lines(out)
+        if isinstance(exc, subprocess.TimeoutExpired):
+            return results, f"{rung}: timeout after {timeout}s"
+        if isinstance(exc, _Killed):
+            exc.results = results
+            exc.rung = rung
+        raise
+    results = _parse_lines(out)
 
     def diag():
         """Prefer the exception over trailing log spam: the last
         'rung failed:'/Traceback block of stderr, else raw tails."""
-        err_txt = proc.stderr or ""
+        txt = err_txt or ""
         for marker in ("rung failed:", "Traceback (most recent call last)"):
-            i = err_txt.rfind(marker)
+            i = txt.rfind(marker)
             if i >= 0:
-                return err_txt[i:i + 1200]
-        return (err_txt[-1000:] + (proc.stdout or "")[-300:])
+                return txt[i:i + 1200]
+        return (txt[-1000:] + (out or "")[-300:])
 
     if proc.returncode != 0:
         return results, f"{rung}: rc={proc.returncode}: {diag()}"
@@ -1334,80 +1476,118 @@ CPU_PLAN = [("kernels_aot", 400, CPU_ENV, False),
             ("train", 700, CPU_ENV, False)]
 
 
+class _Killed(Exception):
+    """Raised from the SIGTERM handler: the outer harness' `timeout` sends
+    SIGTERM before SIGKILL (rc=124). Raising is the only way to interrupt a
+    blocking subprocess.run wait, and the whole point is to reach the
+    aggregate-flush path below with whatever results exist — the r05
+    failure was dying with every rung line buffered in children."""
+
+
 def main():
+    import signal
+
+    def _on_term(signum, frame):
+        raise _Killed(signum)
+
+    signal.signal(signal.SIGTERM, _on_term)
     deadline = time.monotonic() + float(
         os.environ.get("DSTPU_BENCH_DEADLINE", 3300))
     all_results, errors = [], []
-
     watcher = _ProbeWatcher()
-    platform = watcher.probe_once(45) or "cpu"
-    if platform != "tpu":
-        errors.append(f"probe: {watcher.attempts[-1]['outcome']}")
-        watcher.start_background(deadline)
-
-    plan = list(TPU_PLAN if platform == "tpu" else CPU_PLAN)
-    on_tpu = platform == "tpu"
-    # done is keyed (rung, tier): a CPU run of a rung must NOT block its
-    # TPU variant after a mid-window tunnel recovery — the TPU numbers are
-    # the perf story, the CPU ones are the fallback
-    done = set()
 
     def tier(env):
         return "cpu" if env else "tpu"
 
+    plan = []
     degraded = False
-    while plan:
-        # tunnel came up mid-window: switch to the TPU plan for the
-        # remaining time (kernels first — bank silicon evidence)
-        if not on_tpu and watcher.found.is_set():
-            on_tpu = True
-            platform = "tpu"
-            plan = [p for p in TPU_PLAN if (p[0], "tpu") not in done]
-            continue
-        rung, timeout, env, cpu_retry = plan.pop(0)
-        if (rung, tier(env)) in done:
-            continue
-        remaining = deadline - time.monotonic()
-        if remaining < 60:
-            errors.append(f"{rung}: skipped (deadline)")
-            continue
-        if degraded and not env:
-            env, cpu_retry = CPU_ENV, False
-            if rung.startswith("kernels"):
-                errors.append(f"{rung}: skipped (TPU degraded)")
-                continue
-        results, err = _spawn(rung, min(timeout, remaining), env)
-        done.add((rung, tier(env)))
-        for r in results:
-            _emit(r)
-        all_results.extend(results)
-        if err:
-            errors.append(err)
-            if not env:  # a TPU attempt failed
-                # only a TIMEOUT implicates the platform (hung tunnel) —
-                # a deterministic rung failure (rc!=0) must not cost the
-                # remaining rungs their TPU window
-                if "timeout" in err:
-                    degraded = True
-                if cpu_retry and deadline - time.monotonic() > 120:
-                    results, err2 = _spawn(
-                        rung, min(600, deadline - time.monotonic()), CPU_ENV)
-                    for r in results:
-                        _emit(r)
-                    all_results.extend(results)
-                    if err2:
-                        errors.append(err2)
-        # the CPU plan finished but real window remains: idle-wait on the
-        # watcher so a late tunnel still banks TPU evidence (the old
-        # late-salvage path, now watcher-driven)
-        if not plan and not on_tpu and not degraded:
-            while (deadline - time.monotonic() > 360
-                   and not watcher.found.is_set()):
-                time.sleep(20)
-            if watcher.found.is_set():
+    # the try must start HERE, not at the rung loop: the 45s TPU probe
+    # below is exactly where an outer `timeout -s TERM ... 45` lands its
+    # SIGTERM, and a _Killed escaping uncaught skips the aggregate flush
+    # this handler exists to guarantee
+    try:
+        platform = watcher.probe_once(45) or "cpu"
+        if platform != "tpu":
+            errors.append(f"probe: {watcher.attempts[-1]['outcome']}")
+            watcher.start_background(deadline)
+
+        plan = list(TPU_PLAN if platform == "tpu" else CPU_PLAN)
+        on_tpu = platform == "tpu"
+        # done is keyed (rung, tier): a CPU run of a rung must NOT block
+        # its TPU variant after a mid-window tunnel recovery — the TPU
+        # numbers are the perf story, the CPU ones are the fallback
+        done = set()
+
+        while plan:
+            # tunnel came up mid-window: switch to the TPU plan for the
+            # remaining time (kernels first — bank silicon evidence)
+            if not on_tpu and watcher.found.is_set():
                 on_tpu = True
                 platform = "tpu"
                 plan = [p for p in TPU_PLAN if (p[0], "tpu") not in done]
+                continue
+            rung, timeout, env, cpu_retry = plan.pop(0)
+            if (rung, tier(env)) in done:
+                continue
+            remaining = deadline - time.monotonic()
+            if remaining < 60:
+                errors.append(f"{rung}: skipped (deadline)")
+                continue
+            if degraded and not env:
+                env, cpu_retry = CPU_ENV, False
+                if rung.startswith("kernels"):
+                    errors.append(f"{rung}: skipped (TPU degraded)")
+                    continue
+            results, err = _spawn(rung, min(timeout, remaining), env)
+            done.add((rung, tier(env)))
+            for r in results:
+                _emit(r)
+            all_results.extend(results)
+            if err:
+                errors.append(err)
+                if not env:  # a TPU attempt failed
+                    # only a TIMEOUT implicates the platform (hung tunnel) —
+                    # a deterministic rung failure (rc!=0) must not cost the
+                    # remaining rungs their TPU window
+                    if "timeout" in err:
+                        degraded = True
+                    if cpu_retry and deadline - time.monotonic() > 120:
+                        results, err2 = _spawn(
+                            rung, min(600, deadline - time.monotonic()), CPU_ENV)
+                        for r in results:
+                            _emit(r)
+                        all_results.extend(results)
+                        if err2:
+                            errors.append(err2)
+            # the CPU plan finished but real window remains: idle-wait on the
+            # watcher so a late tunnel still banks TPU evidence (the old
+            # late-salvage path, now watcher-driven)
+            if not plan and not on_tpu and not degraded:
+                while (deadline - time.monotonic() > 360
+                       and not watcher.found.is_set()):
+                    time.sleep(20)
+                if watcher.found.is_set():
+                    on_tpu = True
+                    platform = "tpu"
+                    plan = [p for p in TPU_PLAN if (p[0], "tpu") not in done]
+    except _Killed as e:
+        # a second SIGTERM during the salvage emits below must not
+        # interrupt them — ignore it before doing any more work
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        # a kill that landed mid-rung carries whatever the child had
+        # flushed (salvaged by _spawn's drain) — bank it like any rung
+        salvaged = getattr(e, "results", [])
+        for r in salvaged:
+            _emit(r)
+        all_results.extend(salvaged)
+        rung = getattr(e, "rung", None)
+        if rung:
+            errors.append(f"{rung}: killed mid-rung (SIGTERM)")
+        errors.append(f"bench: SIGTERM ({e.args[0]}) — flushing "
+                      f"partial aggregate (outer timeout imminent)")
+    # the tail below IS the flush: a second SIGTERM must not interrupt it
+    # (the outer timeout's SIGKILL is the backstop)
+    signal.signal(signal.SIGTERM, signal.SIG_IGN)
     watcher.stop()
     probe_attempts = watcher.attempts
 
@@ -1422,8 +1602,17 @@ def main():
         pool = tpu or lines
         return max(pool, key=lambda r: r.get("vs_baseline") or 0.0)
 
+    def _is_partial(r):
+        return bool((r.get("detail") or {}).get("partial"))
+
     def pick(prefix):
-        cands = [r for r in all_results if r["metric"].startswith(prefix)]
+        # partial per-scenario flush lines (serve arms, goodput load
+        # points) are evidence, not headlines — prefer a complete rung
+        # line, fall back to a partial only when nothing else survived
+        full = [r for r in all_results
+                if r["metric"].startswith(prefix) and not _is_partial(r)]
+        cands = full or [r for r in all_results
+                         if r["metric"].startswith(prefix)]
         if not cands:
             return None
         if prefix == "train":
@@ -1441,7 +1630,8 @@ def main():
     # prefer a REAL-TPU line as the headline over a CPU line of an
     # earlier-preferred rung (CPU train numbers are not the perf story)
     tpu_lines = [r for r in all_results
-                 if r.get("detail", {}).get("platform") == "tpu"]
+                 if r.get("detail", {}).get("platform") == "tpu"
+                 and not _is_partial(r)]
     if head.get("detail", {}).get("platform") != "tpu" and tpu_lines:
         for prefix in ("train", "serve", "kernel"):
             cands = [r for r in tpu_lines

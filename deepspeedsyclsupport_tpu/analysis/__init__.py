@@ -1,0 +1,36 @@
+"""Static analysis of compiled JAX step programs + codebase invariants.
+
+Two halves, one motivation: every property this package checks used to be
+enforced only by runtime telemetry or reviewer memory, and each of the
+roadmap's perf directions (quantized ZeRO++ collectives, Pallas MFU work,
+the shard_map-native refactor) needs the *compiled program's* behavior —
+bytes on the wire, buffers donated, dtypes kept, layouts stable — proven
+before and after the change.
+
+Graph lint (``collectives``, ``donation``, ``dtype_audit``, ``resharding``):
+analyzers over a lowered/compiled train or infer step. Under JAX these are
+exact static analyses — the program is a closed jaxpr/HLO module, the same
+property ``profiling/flops_profiler.py`` exploits for FLOPs.
+
+Codebase lint (``codelint`` + ``baseline``): an AST rule engine encoding the
+invariants PRs 1-2 paid for in debugging (async-signal-safe handlers,
+declared monitor event names, monotonic step timing, no stray host syncs in
+hot loops), reported against a checked-in baseline so existing debt is
+visible but only NEW violations fail. CLI: ``tools/dslint.py``.
+"""
+from .capture import abstract_step_args
+from .collectives import (CollectiveClasses, CollectiveExpectation,
+                          check_collectives, classify_collectives,
+                          collective_census, expected_train_collectives)
+from .donation import DonationReport, donation_audit
+from .dtype_audit import DtypeReport, dtype_audit
+from .resharding import ReshardingReport, resharding_audit
+
+__all__ = [
+    "abstract_step_args",
+    "collective_census", "classify_collectives", "expected_train_collectives",
+    "check_collectives", "CollectiveExpectation", "CollectiveClasses",
+    "donation_audit", "DonationReport",
+    "dtype_audit", "DtypeReport",
+    "resharding_audit", "ReshardingReport",
+]

@@ -1,0 +1,32 @@
+"""Shared capture of a step's abstract args (avals + shardings).
+
+One definition of "what does this step program take" serves three callers:
+the engine's comms logging (HLO re-lowering without holding donated
+arrays), the post-hoc ``Engine.graph_report`` analyzers, and tests that
+lower a step at exactly the shapes a real run used. Previously this lived
+as an ``aval()`` closure inside ``runtime/engine.py`` — deduplicated here.
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def abstract_leaf(x: Any) -> jax.ShapeDtypeStruct:
+    """Abstract aval of one array-like leaf, keeping its mesh-wide sharding.
+
+    Only mesh-wide ``NamedSharding``s transfer to abstract avals;
+    single-device-committed leaves (host scaler pieces) must stay
+    unconstrained or lowering sees a device clash.
+    """
+    from jax.sharding import NamedSharding
+
+    s = getattr(x, "sharding", None)
+    s = s if isinstance(s, NamedSharding) else None
+    return jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x), sharding=s)
+
+
+def abstract_step_args(tree: Any) -> Any:
+    """ShapeDtypeStruct pytree mirroring ``tree`` — enough to re-lower the
+    step program (a compile-cache hit) without pinning the real buffers."""
+    return jax.tree_util.tree_map(abstract_leaf, tree)

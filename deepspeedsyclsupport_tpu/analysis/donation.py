@@ -1,0 +1,142 @@
+"""Donation audit: donatable buffers that a compiled step failed to alias.
+
+``jax.jit(..., donate_argnums=...)`` is a *request*; XLA only aliases an
+input to an output when shapes/layouts line up and the value is provably
+dead. A donated-but-unaliased param or optimizer-state buffer silently
+doubles its HBM footprint every step — invisible at runtime until the OOM.
+The compiled module states the truth in its header::
+
+    input_output_alias={ {0}: (0, {}, may-alias), ... }
+
+so the audit is exact: flatten the donatable arg subtree, map flat indices
+to tree paths, and flag every leaf whose parameter index never appears on
+the right-hand side of the alias map.
+"""
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+_ALIAS_ENTRY_RE = re.compile(r"\{[0-9,\s]*\}:\s*\((\d+)")
+
+
+def parse_aliased_params(hlo_text: str) -> List[int]:
+    """Entry-parameter indices the compiled module aliases to outputs."""
+    start = hlo_text.find("input_output_alias={")
+    if start < 0:
+        return []
+    i = start + len("input_output_alias=")
+    depth, j = 0, i
+    while j < len(hlo_text):  # brace-matched block (entries nest {} inside)
+        if hlo_text[j] == "{":
+            depth += 1
+        elif hlo_text[j] == "}":
+            depth -= 1
+            if depth == 0:
+                break
+        j += 1
+    block = hlo_text[i:j + 1]
+    return sorted({int(g) for g in _ALIAS_ENTRY_RE.findall(block)})
+
+
+@dataclass
+class DonationReport:
+    ok: bool
+    donated: List[str] = field(default_factory=list)       # tree paths
+    not_donated: List[Dict[str, Any]] = field(default_factory=list)
+    wasted_bytes: int = 0          # HBM doubled by missed donations
+    unmapped: bool = False         # flat index mapping could not be trusted
+
+    def report(self) -> str:
+        lines = [f"donation audit: {'OK' if self.ok else 'FAIL'} "
+                 f"({len(self.donated)} donated, "
+                 f"{len(self.not_donated)} missed, "
+                 f"{self.wasted_bytes} B doubled)"]
+        for miss in self.not_donated:
+            lines.append(f"  NOT DONATED: {miss['path']} "
+                         f"{miss['shape']}:{miss['dtype']} "
+                         f"({miss['bytes']} B)")
+        if self.unmapped:
+            lines.append("  (flat arg mapping unverified: entry parameter "
+                         "count != argument leaf count)")
+        return "\n".join(lines)
+
+
+def donation_audit(compiled: Any, args: Sequence[Any],
+                   donate_argnums: Tuple[int, ...]) -> DonationReport:
+    """Audit one compiled step.
+
+    ``args`` are the call arguments (arrays or ShapeDtypeStructs — only
+    tree structure/shape/dtype are read); ``donate_argnums`` the argnums the
+    call site requested donation for. Flat entry-parameter order is the
+    flattened order of ``args`` — verified against the module's parameter
+    count before any leaf is blamed.
+    """
+    import jax
+
+    text = compiled.as_text() if not isinstance(compiled, str) else compiled
+    aliased_entry = set(parse_aliased_params(text))
+    n_params_re = re.search(r"entry_computation_layout=\{\((.*?)\)->", text,
+                            re.S)
+    n_entry = (len(_split_top(n_params_re.group(1))) if n_params_re else -1)
+
+    flat: List[Tuple[str, Any]] = []
+    donatable: List[int] = []
+    idx = 0
+    for argnum, arg in enumerate(args):
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(arg)[0]:
+            flat.append((f"arg{argnum}{jax.tree_util.keystr(kp)}", leaf))
+            if argnum in donate_argnums:
+                donatable.append(idx)
+            idx += 1
+
+    # entry parameter j is flat leaf kept[j]: jit prunes unused leaves
+    # (an unused rng, a dead config scalar) from the entry computation
+    kept = getattr(getattr(compiled, "_executable", None),
+                   "_kept_var_idx", None)
+    kept = sorted(kept) if kept is not None else list(range(len(flat)))
+    unmapped = n_entry >= 0 and n_entry != len(kept)
+    aliased = {kept[j] for j in aliased_entry if j < len(kept)}
+    pruned = set(range(len(flat))) - set(kept)
+
+    donated, missed, wasted = [], [], 0
+    for i in donatable:
+        path, leaf = flat[i]
+        if i in aliased or i in pruned:
+            # pruned: the program never consumes this leaf, so there is no
+            # buffer to double — donation is moot, not missed
+            donated.append(path)
+            continue
+        shape = tuple(np.shape(leaf))
+        if not shape:
+            # scalar leaves (step counters, hyperparams) cost nothing;
+            # report only tensors whose doubling matters
+            continue
+        dt = np.dtype(getattr(leaf, "dtype", np.float32))
+        nbytes = int(np.prod(shape)) * dt.itemsize
+        missed.append({"path": path, "shape": shape, "dtype": str(dt),
+                       "bytes": nbytes, "flat_index": i})
+        wasted += nbytes
+    return DonationReport(ok=not missed and not unmapped, donated=donated,
+                          not_donated=missed, wasted_bytes=wasted,
+                          unmapped=unmapped)
+
+
+def _split_top(s: str) -> List[str]:
+    """Split an entry-layout tuple body on top-level commas (shapes may
+    contain ``{...}`` layout braces and ``/*index=N*/`` comments)."""
+    parts, depth, cur = [], 0, []
+    for ch in s:
+        if ch in "({[":
+            depth += 1
+        elif ch in ")}]":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur and "".join(cur).strip():
+        parts.append("".join(cur))
+    return [p for p in parts if p.strip()]

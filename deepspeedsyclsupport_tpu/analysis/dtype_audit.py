@@ -1,0 +1,92 @@
+"""Dtype-promotion audit: f32 upcasts hiding inside a declared-bf16 graph.
+
+Mixed precision dies by a thousand silent promotions: one stray f32
+constant or ``astype`` and a whole activation chain runs at double width —
+2× the HBM traffic and none of the MXU rate the bf16 config promised. In a
+closed jaxpr every promotion is a ``convert_element_type`` equation, so the
+audit is exact.
+
+Sanctioned promotions (the master-weight pattern) are excluded by shape:
+gradients/master params are *param-shaped*, and upcasting them to f32 for
+the optimizer is the point of mixed precision. What gets flagged are
+*activation-shaped* upcasts above a size floor — the ones that ride the
+batch through the matmuls.
+"""
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from .jaxpr_walk import iter_eqns
+
+LOW_DTYPES = ("bfloat16", "float16")
+#: upcasts below this element count are noise (loss terms, norms, indices)
+DEFAULT_MIN_ELEMENTS = 4096
+
+
+@dataclass
+class DtypeReport:
+    ok: bool
+    upcasts: List[Dict[str, Any]] = field(default_factory=list)
+    total_upcast_bytes: int = 0      # extra bytes materialized at f32
+    sanctioned: int = 0              # param-shaped (master-weight) upcasts
+
+    def report(self) -> str:
+        lines = [f"dtype audit: {'OK' if self.ok else 'FAIL'} "
+                 f"({len(self.upcasts)} activation upcasts, "
+                 f"{self.total_upcast_bytes} B widened, "
+                 f"{self.sanctioned} param-shaped upcasts sanctioned)"]
+        for u in self.upcasts:
+            lines.append(f"  UPCAST {u['from']} -> {u['to']} at shape "
+                         f"{u['shape']} x{u['mult']:g} ({u['bytes']} B)")
+        return "\n".join(lines)
+
+
+def dtype_audit(fn_or_jaxpr: Any, *args: Any,
+                allowed_shapes: Optional[Sequence[Tuple[int, ...]]] = None,
+                min_elements: int = DEFAULT_MIN_ELEMENTS,
+                **kwargs: Any) -> DtypeReport:
+    """Walk a jaxpr (or trace ``fn(*args)``) for low→f32 promotions.
+
+    ``allowed_shapes``: shapes whose upcast is the sanctioned master-weight
+    pattern (pass the param leaf shapes of the step). Scan bodies multiply
+    reported bytes by their trip count.
+    """
+    import jax
+
+    jaxpr = fn_or_jaxpr
+    if callable(fn_or_jaxpr) and not hasattr(fn_or_jaxpr, "eqns"):
+        jaxpr = jax.make_jaxpr(fn_or_jaxpr)(*args, **kwargs)
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    allowed: Set[Tuple[int, ...]] = {tuple(s) for s in (allowed_shapes or ())}
+
+    upcasts: List[Dict[str, Any]] = []
+    sanctioned = 0
+    total = 0
+    for eqn, mult in iter_eqns(jaxpr):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        src = eqn.invars[0].aval
+        dst = eqn.outvars[0].aval
+        if str(src.dtype) not in LOW_DTYPES or str(dst.dtype) != "float32":
+            continue
+        shape = tuple(src.shape)
+        n = int(np.prod(shape)) if shape else 1
+        if n < min_elements:
+            continue
+        if shape in allowed or (
+                len(shape) > 2 and shape[1:] in allowed):
+            # param-shaped (incl. a scanned/stacked leading dim): the
+            # master-weight grad upcast — sanctioned by construction.
+            # The leading-dim rule requires the trailing shape to be a
+            # MATRIX param (len > 2): a 1-D allowed shape (a bias) must not
+            # excuse (batch, bias_dim) activation upcasts — exactly the
+            # promotion this audit exists to catch
+            sanctioned += 1
+            continue
+        nbytes = int(n * 2 * mult)   # extra bytes: f32 copy minus bf16 source
+        upcasts.append({"from": str(src.dtype), "to": "float32",
+                        "shape": shape, "mult": mult, "bytes": nbytes})
+        total += nbytes
+    return DtypeReport(ok=not upcasts, upcasts=upcasts,
+                       total_upcast_bytes=total, sanctioned=sanctioned)

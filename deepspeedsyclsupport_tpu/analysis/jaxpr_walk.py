@@ -1,0 +1,45 @@
+"""Recursive jaxpr traversal shared by the static analyzers.
+
+Generalized from the walker in ``profiling/flops_profiler.py`` (which now
+uses it): yields every equation with its static trip multiplier, descending
+into call/scan/while/cond sub-jaxprs. ``scan`` bodies multiply by the
+static ``length``; ``cond`` descends into EVERY branch (branch order in
+``eqn.params['branches']`` is lowering-defined — for ``lax.cond`` index 0
+is the FALSE branch — so picking one positionally audits the wrong code;
+walking all over-approximates, which is the safe direction for audits and
+for FLOPs of the skip-vs-run pattern, where the skip branch is ~empty).
+"""
+from typing import Any, Iterator, List, Tuple
+
+#: eqn.params keys that hold sub-jaxprs (possibly lists of them)
+SUBJAXPR_PARAMS = ("jaxpr", "call_jaxpr", "cond_jaxpr", "body_jaxpr",
+                   "branches")
+
+
+def subjaxprs(eqn) -> List[Any]:
+    """The sub-jaxprs of one equation, unwrapped from ClosedJaxpr."""
+    subs: List[Any] = []
+    for p in SUBJAXPR_PARAMS:
+        v = eqn.params.get(p)
+        if v is None:
+            continue
+        vs = v if isinstance(v, (list, tuple)) else [v]
+        subs.extend(getattr(s, "jaxpr", s) for s in vs)
+    return subs
+
+
+def iter_eqns(jaxpr, mult: float = 1.0) -> Iterator[Tuple[Any, float]]:
+    """Yield ``(eqn, trip_multiplier)`` for every *leaf* equation reachable
+    from ``jaxpr``. Equations that only wrap a sub-jaxpr are descended into,
+    not yielded."""
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        sub_mult = mult
+        if name == "scan":
+            sub_mult = mult * eqn.params.get("length", 1)
+        subs = subjaxprs(eqn)
+        if subs:
+            for s in subs:
+                yield from iter_eqns(s, sub_mult)
+            continue
+        yield eqn, mult

@@ -1,0 +1,111 @@
+"""Resharding detector: device-to-device copies from mismatched shardings.
+
+Two ``NamedSharding``s that disagree about a value's layout cost a
+collective every step — XLA silently inserts all-to-all / collective-permute
+(or gather+slice) to move the data, and the step "works", just slower.
+Detection is two-sided:
+
+* **boundary**: the shardings the compiled executable *wants* for its
+  inputs vs the shardings the caller's arrays *have*. A mismatch means jax
+  copies that argument at every dispatch (host-visible resharding).
+* **internal**: collective traffic the census could not attribute to the
+  canonical classes (param-gather / grad-sync / scalar) — all-to-all and
+  collective-permute entries are the partitioner's resharding spellings,
+  plus unattributed gathers over activation-shaped payloads.
+
+The internal side shares classification with ``collectives.py``: run the
+census check first and hand its ``other`` class here.
+"""
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+from .collectives import classify_collectives, collective_census
+
+RESHARD_OPS = ("all-to-all", "collective-permute")
+
+
+@dataclass
+class ReshardingReport:
+    ok: bool
+    boundary_mismatches: List[Dict[str, Any]] = field(default_factory=list)
+    internal_suspects: List[Dict[str, Any]] = field(default_factory=list)
+    suspect_bytes: int = 0
+
+    def report(self) -> str:
+        lines = [f"resharding audit: {'OK' if self.ok else 'FAIL'} "
+                 f"({len(self.boundary_mismatches)} boundary, "
+                 f"{len(self.internal_suspects)} internal, "
+                 f"{self.suspect_bytes} B/step)"]
+        for b in self.boundary_mismatches:
+            lines.append(f"  BOUNDARY arg {b['index']}: given {b['given']} "
+                         f"!= compiled {b['wanted']}")
+        for s in self.internal_suspects:
+            lines.append(f"  INTERNAL {s['op']} {s['shape']} "
+                         f"({s['bytes']} B)")
+        return "\n".join(lines)
+
+
+def resharding_audit(compiled: Any,
+                     params: Any = None,
+                     param_shardings: Any = None,
+                     given_in_shardings: Optional[Sequence[Any]] = None,
+                     census: Optional[Sequence[Dict[str, Any]]] = None,
+                     ) -> ReshardingReport:
+    """Audit one compiled step for resharding traffic.
+
+    ``params``/``param_shardings`` feed the census classifier so canonical
+    param/grad traffic is not blamed; without them every collective in a
+    reshard-spelling opcode is a suspect. ``given_in_shardings`` is the flat
+    list of shardings the caller's arrays actually carry (``None`` entries
+    skip the comparison).
+    """
+    census = list(census if census is not None
+                  else collective_census(compiled))
+    if params is not None:
+        other = classify_collectives(census, params, param_shardings).other
+    else:
+        other = [r for r in census if r["op"] in RESHARD_OPS]
+    suspects = [r for r in other
+                if r["op"] in RESHARD_OPS or r["op"] == "all-gather"]
+
+    boundary: List[Dict[str, Any]] = []
+    if given_in_shardings is not None:
+        wanted = _flat_input_shardings(compiled)
+        for i, (giv, want) in enumerate(zip(given_in_shardings, wanted)):
+            if giv is None or want is None:
+                continue
+            if not _shardings_equal(giv, want):
+                boundary.append({"index": i, "given": _spec_str(giv),
+                                 "wanted": _spec_str(want)})
+    return ReshardingReport(
+        ok=not boundary and not suspects,
+        boundary_mismatches=boundary, internal_suspects=suspects,
+        suspect_bytes=sum(s["bytes"] for s in suspects))
+
+
+def _flat_input_shardings(compiled: Any) -> List[Any]:
+    try:
+        args_sh, kw_sh = compiled.input_shardings
+        flat = list(args_sh) + list(kw_sh.values())
+        return flat
+    except Exception:  # backend/version dependent
+        return []
+
+
+def _spec_str(s: Any) -> str:
+    spec = getattr(s, "spec", None)
+    return str(spec) if spec is not None else str(s)
+
+
+def _shardings_equal(a: Any, b: Any) -> bool:
+    sa, sb = getattr(a, "spec", None), getattr(b, "spec", None)
+    if sa is None or sb is None:
+        return str(a) == str(b)
+
+    def norm(spec):
+        t = [tuple(e) if isinstance(e, tuple) else e for e in spec]
+        while t and t[-1] is None:  # trailing Nones are implicit
+            t.pop()
+        return tuple(t)
+
+    return norm(sa) == norm(sb)

@@ -137,7 +137,9 @@ class DSElasticAgent:
             # first compile) never writes one — count staleness from launch.
             # Enabling the watch therefore REQUIRES worker telemetry
             # heartbeats; size the timeout to cover startup + first compile.
-            age = time.time() - launched_at
+            # launched_at is monotonic: an NTP step during init must not
+            # spuriously declare (or mask) a hang.
+            age = time.monotonic() - launched_at
         return age > self.heartbeat_timeout
 
     def _launch(self, env: Dict[str, str]) -> int:
@@ -157,7 +159,7 @@ class DSElasticAgent:
             os.unlink(self.heartbeat_file)
         except OSError:
             pass
-        launched_at = time.time()
+        launched_at = time.monotonic()
         proc = subprocess.Popen(self.cmd, env=env)
         while True:
             rc = proc.poll()

@@ -25,7 +25,7 @@ import shlex
 import signal
 import subprocess
 import sys
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..utils.logging import logger
 
@@ -281,31 +281,43 @@ def _child_preexec():  # pragma: no cover - runs in the forked child
         pass
 
 
+def _wait_all(procs: List[subprocess.Popen], grace: float) -> bool:
+    """Poll until every proc is reaped or the window closes."""
+    import time
+
+    deadline = time.monotonic() + grace
+    while time.monotonic() < deadline:
+        if all(p.poll() is not None for p in procs):
+            return True
+        time.sleep(0.05)
+    return all(p.poll() is not None for p in procs)
+
+
 def _terminate_tree(procs: List[subprocess.Popen],
                     grace: float = 5.0) -> None:
     """SIGTERM every child's process GROUP, escalate to SIGKILL after the
     grace window (reference ``launcher/launch.py:118``: terminate_process_
     tree on SIGTERM — children of children must not survive the launcher).
     """
-    import time
-
     for p in procs:
         if p.poll() is None:
             try:
                 os.killpg(os.getpgid(p.pid), signal.SIGTERM)
             except (ProcessLookupError, PermissionError):
                 pass
-    deadline = time.monotonic() + grace
-    while time.monotonic() < deadline:
-        if all(p.poll() is not None for p in procs):
-            return
-        time.sleep(0.05)
+    if _wait_all(procs, grace):
+        return
     for p in procs:
         if p.poll() is None:
             try:
                 os.killpg(os.getpgid(p.pid), signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
                 pass
+    # SIGKILL delivery is asynchronous: on a loaded machine the child may
+    # not be reapable for whole scheduler quanta after killpg() returns.
+    # Callers (supervise fail-fast, the tests) rely on poll() being
+    # conclusive once this returns, so wait the grace window again.
+    _wait_all(procs, grace)
 
 
 def supervise(procs: List[subprocess.Popen], grace: float = 5.0,
@@ -315,15 +327,36 @@ def supervise(procs: List[subprocess.Popen], grace: float = 5.0,
     reference's any-rank-failure semantics, ``launch.py`` main loop)."""
     import time
 
+    pending_sig: List[Optional[int]] = [None]  # slot store; loop drains it
+
     def _on_signal(signum, frame):
-        logger.warning("launcher: signal %d — terminating process trees",
-                       signum)
-        _terminate_tree(procs, grace)
+        # store-only handler (the runtime/resilience.py contract, enforced
+        # by dslint signal-handler-safety): logging here can deadlock on
+        # the lock the interrupted frame holds, and _terminate_tree sleeps
+        # up to `grace` seconds — both belong in the supervision loop
+        pending_sig[0] = signum
 
     prev_int = signal.signal(signal.SIGINT, _on_signal)
     prev_term = signal.signal(signal.SIGTERM, _on_signal)
     try:
         while True:
+            if pending_sig[0] is not None:
+                signum = pending_sig[0]
+                logger.warning("launcher: signal %d — terminating process "
+                               "trees", signum)
+                _terminate_tree(procs, grace)
+                # a worker that caught the signal and exited by contract
+                # keeps its rc: PREEMPTION_EXIT_CODE (217) must reach the
+                # elastic agent for free-restart accounting, and any other
+                # deliberate non-zero exit beats the generic 128+signum
+                from ..runtime.resilience import PREEMPTION_EXIT_CODE
+
+                codes = [p.poll() for p in procs]
+                if any(c == PREEMPTION_EXIT_CODE for c in codes):
+                    return PREEMPTION_EXIT_CODE
+                bad = next((c for c in codes if c not in (None, 0)
+                            and c > 0), None)
+                return bad if bad is not None else 128 + signum
             codes = [p.poll() for p in procs]
             bad = next((c for c in codes if c not in (None, 0)), None)
             if bad is not None:

@@ -209,7 +209,8 @@ class Gauge:
 
     def set(self, value: float) -> None:
         self._value = float(value)
-        self._t = time.time()
+        # wall timestamp (when was this gauge last set), not a duration
+        self._t = time.time()  # dslint: allow(wall-clock-in-step-path)
 
     @property
     def value(self) -> float:
@@ -336,7 +337,10 @@ class FlightRecorder:
     def record(self, kind: str, name: str, step: Optional[int] = None,
                dur: Optional[float] = None, value: Any = None,
                data: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
-        rec: Dict[str, Any] = {"kind": kind, "name": name, "t": time.time()}
+        # "t" is an epoch timestamp for offline correlation across ranks —
+        # wall clock by design; durations ("dur") come from perf_counter
+        rec: Dict[str, Any] = {"kind": kind, "name": name,
+                               "t": time.time()}  # dslint: allow(wall-clock-in-step-path)
         if step is not None:
             rec["step"] = int(step)
         if dur is not None:
@@ -594,7 +598,10 @@ class Heartbeat:
         hb = Heartbeat.read(path)
         if hb is None or "t" not in hb:
             return None
-        return (now if now is not None else time.time()) - float(hb["t"])
+        # cross-PROCESS freshness: the beat's "t" is another process's wall
+        # clock, so the comparison clock must be wall too (same host)
+        return (now if now is not None
+                else time.time()) - float(hb["t"])  # dslint: allow(wall-clock-in-step-path)
 
 
 _faulthandler_installed = False

@@ -12,7 +12,7 @@ Engine integration mirrors the reference's ``flops_profiler_profile_step``
 train function and logs total GFLOPs, parameter count, and achieved TFLOPS.
 """
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import numpy as np
@@ -87,37 +87,21 @@ _CHEAP = {"add", "sub", "mul", "div", "max", "min", "neg", "abs", "exp", "log",
           "tanh", "logistic", "rsqrt", "sqrt", "pow", "integer_pow", "erf",
           "select_n", "clamp", "sign", "floor", "ceil", "round", "cos", "sin",
           "square", "reciprocal", "logaddexp", "atan2", "expm1", "log1p"}
-_SUBJAXPR_PARAMS = ("jaxpr", "call_jaxpr", "cond_jaxpr", "body_jaxpr",
-                    "branches")
 
 
 def count_jaxpr_flops(jaxpr, by: Optional[Dict[str, float]] = None,
                       mult: float = 1.0) -> Dict[str, float]:
-    """Recursive per-primitive FLOP count. Loop bodies (scan/while) multiply by
-    trip count when static (scan ``length``)."""
+    """Per-primitive FLOP count over the recursive equation stream
+    (``analysis/jaxpr_walk.py`` — scan bodies multiply by static trip
+    count; cond sums every branch, an over-approximation that is ~exact
+    for the skip-vs-run pattern where the skip branch is empty)."""
+    from ..analysis.jaxpr_walk import iter_eqns
+
     by = by if by is not None else {}
-    for eqn in jaxpr.eqns:
+    for eqn, eq_mult in iter_eqns(jaxpr, mult):
         name = eqn.primitive.name
-        sub_mult = mult
-        if name == "scan":
-            sub_mult = mult * eqn.params.get("length", 1)
-        subs: List[Any] = []
-        for p in _SUBJAXPR_PARAMS:
-            v = eqn.params.get(p)
-            if v is None:
-                continue
-            vs = v if isinstance(v, (list, tuple)) else [v]
-            subs.extend(vs)
-        if subs:
-            for s in subs:
-                inner = getattr(s, "jaxpr", s)
-                if name in ("cond",):  # one branch executes
-                    count_jaxpr_flops(inner, by, mult)
-                    break
-                count_jaxpr_flops(inner, by, sub_mult)
-            continue
         if name == "dot_general":
-            by[name] = by.get(name, 0.0) + _dot_flops(eqn) * mult
+            by[name] = by.get(name, 0.0) + _dot_flops(eqn) * eq_mult
         elif name == "conv_general_dilated":
             out = eqn.outvars[0].aval
             rhs = eqn.invars[1].aval
@@ -126,11 +110,11 @@ def count_jaxpr_flops(jaxpr, by: Optional[Dict[str, float]] = None,
                                 dtype=float)
             cin = rhs.shape[dn.rhs_spec[1]]
             f = 2.0 * np.prod(out.shape, dtype=float) * k_spatial * cin
-            by[name] = by.get(name, 0.0) + f * mult
+            by[name] = by.get(name, 0.0) + f * eq_mult
         elif name in _REDUCTIONS:
-            by[name] = by.get(name, 0.0) + _reduction_flops(eqn) * mult
+            by[name] = by.get(name, 0.0) + _reduction_flops(eqn) * eq_mult
         elif name in _CHEAP:
-            by[name] = by.get(name, 0.0) + _elementwise_flops(eqn) * mult
+            by[name] = by.get(name, 0.0) + _elementwise_flops(eqn) * eq_mult
     return by
 
 

@@ -883,35 +883,40 @@ class Engine:
         if self.offload_device is not None:
             metrics = self._offload_train_batch(batch, rng)
         else:
-            if comms_logger.enabled:
-                # abstract avals (+ shardings) of this step's args, so the
-                # compiled program can be re-lowered for HLO-level comms
-                # accounting without holding the donated arrays
-                def aval(x):
-                    from jax.sharding import NamedSharding
+            # abstract avals (+ shardings) of EXACTLY this step's args —
+            # curriculum truncation, gas reshape and pld_theta included —
+            # so the compiled program can be re-lowered (a compile-cache
+            # hit) for HLO-level comms accounting and graph_report without
+            # holding the donated arrays. Avals only carry
+            # shape/dtype/sharding, and params/opt/scaler keep theirs
+            # across steps, so the full O(param-leaves) tree_map reruns
+            # only when the batch/rng metadata actually changes (curriculum
+            # truncation step, gas reshape) — not every step.
+            key = (jax.tree_util.tree_structure((batch, rng)), tuple(
+                (jnp.shape(x), jnp.result_type(x),
+                 getattr(x, "sharding", None))
+                for x in jax.tree_util.tree_leaves((batch, rng))))
+            if key != getattr(self, "_last_aval_key", None) or \
+                    getattr(self, "_last_train_avals", None) is None:
+                from ..analysis.capture import abstract_step_args
 
-                    # only mesh-wide shardings transfer to abstract avals;
-                    # single-device-committed leaves (host scaler pieces)
-                    # must stay unconstrained or lowering sees a device clash
-                    s = getattr(x, "sharding", None)
-                    s = s if isinstance(s, NamedSharding) else None
-                    return jax.ShapeDtypeStruct(
-                        jnp.shape(x), jnp.result_type(x), sharding=s)
-
-                self._last_train_avals = jax.tree_util.tree_map(
-                    aval, (self.params, self.opt_state, self.scaler_state,
-                           batch, rng))
+                self._last_train_avals = abstract_step_args(
+                    (self.params, self.opt_state, self.scaler_state,
+                     batch, rng))
+                self._last_aval_key = key
             self.params, self.opt_state, self.scaler_state, metrics = \
                 self._train_batch_fn(self.params, self.opt_state,
                                      self.scaler_state, batch, rng)
         if comms_logger.enabled:
-            jax.block_until_ready(metrics["loss"])
+            # opt-in (comms_logger.enabled): straggler wall-clock must be
+            # device-accurate, so this config knowingly trades the overlap
+            jax.block_until_ready(metrics["loss"])  # dslint: allow(host-sync-in-step-path)
             comms_logger.record_wall("train_batch",
                                      time.perf_counter() - t_step)
         elif self.telemetry is not None and self.telemetry.cfg.sync_timing:
             # telemetry.sync_timing: device-accurate step spans — trades the
             # dispatch/compute overlap for timing fidelity (see on_step_end)
-            jax.block_until_ready(metrics["loss"])
+            jax.block_until_ready(metrics["loss"])  # dslint: allow(host-sync-in-step-path)
         step_dur = time.perf_counter() - t_step
         self.global_steps += 1
         self.micro_steps += gas
@@ -972,7 +977,11 @@ class Engine:
         ``comm/comm.py:422``). Re-lowers the train program at the last
         step's avals (a compile-cache hit), parses the optimized HLO, and
         merges per-opcode byte totals into ``comms_logger``."""
-        if getattr(self, "_last_train_avals", None) is None:
+        if not comms_logger.enabled or \
+                getattr(self, "_last_train_avals", None) is None:
+            # avals are captured on every step now, but the summary merges
+            # into comms_logger state — without the logger it has nowhere
+            # to land (use graph_report() for logger-free analysis)
             raise RuntimeError(
                 "run train_batch() with comms_logger enabled first "
                 "(config comms_logger.enabled: true)")
@@ -985,6 +994,74 @@ class Engine:
         if log:
             comms_logger.log_summary(show_straggler=show_straggler)
         return summary
+
+    GRAPH_ANALYZERS = ("collectives", "donation", "resharding", "dtype")
+
+    def graph_report(self, gathers_per_param: Optional[int] = None,
+                     analyzers: Tuple[str, ...] = GRAPH_ANALYZERS,
+                     ) -> Dict[str, Any]:
+        """Static analysis of the compiled train step (``analysis/``):
+        collective census vs the analytic parallelism expectation, donation
+        audit, activation dtype audit and resharding detection.
+
+        Audits EXACTLY the program the last ``train_batch`` ran, from the
+        avals captured at its call site (re-lowering is a compile-cache
+        hit). ``analyzers`` selects a subset — the dtype audit re-traces
+        the raw step with ``make_jaxpr``, which a caller that only wants
+        the donation report (the bench) should not pay for.
+
+        ``gathers_per_param`` defaults from this engine's own remat config
+        (2 when activation checkpointing is on — backward may legally
+        re-gather each ZeRO-3 param — else 1); the analytic budget must
+        not flag a correct remat graph. XLA often hoists the gather out
+        of the remat region anyway, and ``exact=False`` treats the
+        expectation as a ceiling, so 2 stays sound either way.
+        """
+        if gathers_per_param is None:
+            ac = "activation_checkpointing" in self.config.raw and \
+                self.config.activation_checkpointing.enabled
+            gathers_per_param = 2 if ac else 1
+        from ..analysis import (check_collectives, collective_census,
+                                donation_audit, dtype_audit,
+                                expected_train_collectives, resharding_audit)
+
+        if self.offload_device is not None:
+            raise RuntimeError(
+                "graph_report audits the fused train step; the offload path "
+                "splits the step into a grads fn + host apply — audit those "
+                "directly with the analysis.* functions")
+        avals = getattr(self, "_last_train_avals", None)
+        if self._train_batch_fn is None or avals is None:
+            raise RuntimeError("run train_batch() first")
+        compiled = self._train_batch_fn.lower(*avals).compile()
+        report: Dict[str, Any] = {}
+        if "collectives" in analyzers or "resharding" in analyzers:
+            report["census"] = collective_census(compiled)
+        if "collectives" in analyzers:
+            expectation = expected_train_collectives(
+                avals[0], self.topology, self.zero_stage,
+                param_shardings=self.param_shardings,
+                gathers_per_param=gathers_per_param)
+            report["collectives"] = check_collectives(
+                report["census"], expectation, avals[0],
+                self.param_shardings, exact=False)
+        if "donation" in analyzers:
+            report["donation"] = donation_audit(compiled, avals,
+                                                donate_argnums=(0, 1, 2))
+        if "resharding" in analyzers:
+            report["resharding"] = resharding_audit(
+                compiled, params=avals[0],
+                param_shardings=self.param_shardings,
+                census=report["census"])
+        if "dtype" in analyzers:
+            param_shapes = [tuple(np.shape(p))
+                            for p in jax.tree_util.tree_leaves(avals[0])]
+            report["dtype"] = dtype_audit(
+                jax.make_jaxpr(self._train_batch_raw)(*avals)
+                if getattr(self, "_train_batch_raw", None) is not None else
+                jax.make_jaxpr(lambda *a: self._train_batch_fn(*a))(*avals),
+                allowed_shapes=param_shapes)
+        return report
 
     # ================================================================ eager path
     def forward(self, batch):
@@ -1072,7 +1149,10 @@ class Engine:
                 return new_params, new_opt, new_scaler, {
                     "finite": finite, "grad_norm": grad_norm,
                     "loss_scale": new_scaler.scale}
-            self._apply_fn = jax.jit(apply_fn, donate_argnums=(0, 1, 2))
+            # grads donate too (donation-audit find): the accumulator is
+            # dead after this call (_accum_grads is cleared below), and an
+            # undonated fp32 grad tree is a full extra param-sized buffer
+            self._apply_fn = jax.jit(apply_fn, donate_argnums=(0, 1, 2, 3))
         self.timers(STEP_GLOBAL_TIMER).start()
         self.params, self.opt_state, self.scaler_state, metrics = self._apply_fn(
             self.params, self.opt_state, self.scaler_state, self._accum_grads,

@@ -31,7 +31,7 @@ class _Timer:
     def start(self, sync: bool = False):
         if sync:
             _sync()
-        self._start = time.time()
+        self._start = time.perf_counter()
         self.started = True
 
     def stop(self, sync: bool = False, record: bool = True):
@@ -39,7 +39,7 @@ class _Timer:
             return
         if sync:
             _sync()
-        delta = time.time() - self._start
+        delta = time.perf_counter() - self._start
         self._elapsed += delta
         if record:
             self._record.append(delta)
@@ -58,7 +58,7 @@ class _Timer:
         self.started = False
 
     def elapsed(self, reset: bool = True) -> float:
-        now = time.time()
+        now = time.perf_counter()
         value = self._elapsed
         if self.started:
             value += now - self._start
@@ -135,7 +135,7 @@ class ThroughputTimer:
         self.local_step_count = 0
 
     def start(self):
-        self._start_time = time.time()
+        self._start_time = time.perf_counter()
         self.started = True
 
     def stop(self, global_step: bool = True, report_speed: bool = True):
@@ -144,7 +144,7 @@ class ThroughputTimer:
         self.started = False
         self.global_step_count += int(global_step)
         self.local_step_count += 1
-        duration = time.time() - self._start_time
+        duration = time.perf_counter() - self._start_time
         if self.global_step_count > self.start_step:
             self.total_elapsed_time += duration
             self.step_elapsed_time += duration

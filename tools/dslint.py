@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""dslint — static analysis gate for this repo.
+
+Codebase lint (fast, AST-only; the tier-1 gate) checks the invariants in
+``deepspeedsyclsupport_tpu/analysis/codelint.py`` against the checked-in
+debt baseline ``tools/dslint_baseline.json``:
+
+    python tools/dslint.py --check               # exit 0: no NEW violations
+    python tools/dslint.py --update-baseline     # rewrite the baseline
+    python tools/dslint.py --list-rules          # rule names + contracts
+
+Graph lint (slow: compiles a tiny ZeRO-3 train step on 8 virtual devices,
+then runs the collective census, donation, dtype and resharding analyzers
+against it):
+
+    python tools/dslint.py --graph
+
+Exit codes: 0 = clean (or only baselined debt), 1 = new violations /
+failed graph audit, 2 = usage or internal error.
+
+Output format (one line per violation, grep/IDE friendly)::
+
+    path/to/file.py:LINE: [rule-name] message
+
+Suppress a line with ``# dslint: allow(rule-name)`` plus a reason comment;
+baseline pre-existing debt with ``--update-baseline`` (new code should
+never need it).
+"""
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def run_codebase_lint(args) -> int:
+    from deepspeedsyclsupport_tpu.analysis import baseline as B
+    from deepspeedsyclsupport_tpu.analysis import codelint
+
+    violations = codelint.lint_paths(REPO_ROOT)
+    baseline_path = os.path.join(REPO_ROOT, args.baseline)
+
+    if args.update_baseline:
+        counts = B.save_baseline(baseline_path, violations)
+        print(f"dslint: baseline rewritten with {sum(counts.values())} "
+              f"violation(s) across {len(counts)} key(s) -> {args.baseline}")
+        return 0
+
+    check = B.check_against_baseline(violations,
+                                     B.load_baseline(baseline_path))
+    for v in check.new:
+        print(f"{v}  [NEW]")
+    if args.verbose:
+        for v in check.baselined:
+            print(f"{v}  [baselined]")
+    for k in check.stale_keys:
+        print(f"dslint: stale baseline entry (violation fixed — run "
+              f"--update-baseline): {k}")
+    print(f"dslint: {len(check.new)} new, {len(check.baselined)} baselined, "
+          f"{len(check.stale_keys)} stale")
+    return 0 if check.ok else 1
+
+
+def run_graph_lint(_args) -> int:
+    """Compile a tiny canonical ZeRO-3 step and run every graph analyzer —
+    the smoke proof that the analyzers agree with the analytic model on
+    this jax/XLA version (the real gates live in tests/unit/test_analysis.py)."""
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeedsyclsupport_tpu as dstpu
+    from deepspeedsyclsupport_tpu import analysis as A
+
+    class RectModel:
+        def init_params(self):
+            rng = np.random.default_rng(0)
+            return {"w": rng.normal(0, 0.1, (256, 2048)).astype(np.float32),
+                    "b": np.zeros((2048,), np.float32)}
+
+        def loss(self, params, batch, rng):
+            y = jnp.tanh(batch["x"] @ params["w"] + params["b"])
+            return jnp.mean((y - batch["y"]) ** 2)
+
+    cfg = {"train_batch_size": 16, "train_micro_batch_size_per_gpu": 2,
+           "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+           "zero_optimization": {"stage": 3}, "steps_per_print": 10_000}
+    engine, _, _, _ = dstpu.initialize(model=RectModel(), config=cfg)
+    rng = np.random.default_rng(1)
+    batch = {k: jax.device_put(v, engine.topology.data_sharding(v.ndim))
+             for k, v in
+             {"x": rng.normal(0, 1, (16, 256)).astype(np.float32),
+              "y": rng.normal(0, 1, (16, 2048)).astype(np.float32)}.items()}
+    engine.train_batch(batch)
+    report = engine.graph_report()
+    ok = True
+    for name in ("collectives", "donation", "resharding", "dtype"):
+        sub = report[name]
+        print(sub.report())
+        ok = ok and sub.ok
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="dslint", description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--check", action="store_true",
+                   help="codebase lint vs the baseline (default action)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="regenerate the baseline from the current tree")
+    p.add_argument("--graph", action="store_true",
+                   help="compile a tiny ZeRO-3 step and run the graph "
+                        "analyzers (slow)")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--baseline", default=os.path.join("tools",
+                                                      "dslint_baseline.json"))
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="also print baselined violations")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        from deepspeedsyclsupport_tpu.analysis.codelint import ALL_RULES
+        for cls in ALL_RULES:
+            print(f"{cls.name}: {cls.description}")
+        return 0
+    if args.graph:
+        return run_graph_lint(args)
+    return run_codebase_lint(args)
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception as e:  # usage/internal errors are exit 2, not a pass
+        print(f"dslint: error: {e}", file=sys.stderr)
+        sys.exit(2)
