@@ -747,14 +747,23 @@ def _drive_serving(eng, prompts, n_clients, reqs_per_client, gen_len, mode,
             evicted += 1
         stall_guard = 0
     wall = time.perf_counter() - t0
-    ttfts.sort()
-    itls.sort()
+    return _serving_result(wall, total, evicted, total_decoded,
+                           evicted_tokens, ttfts, itls,
+                           getattr(eng, "host_dispatches", 0) - dispatches0,
+                           req_stats)
+
+
+def _serving_result(wall, total, evicted, total_decoded, evicted_tokens,
+                    ttfts, itls, dispatches, req_stats):
+    """One result-dict schema for every serving arm — the A-B comparison
+    depends on both arms computing percentiles/goodput identically."""
+    ttfts = sorted(ttfts)
+    itls = sorted(itls)
 
     def pct(xs, p):
         return xs[min(len(xs) - 1, int(p * len(xs)))] if xs else 0.0
 
     counted = total_decoded - evicted_tokens
-    dispatches = getattr(eng, "host_dispatches", 0) - dispatches0
     itl_mean = sum(itls) / len(itls) if itls else 0.0
     itl_var = (sum((x - itl_mean) ** 2 for x in itls) / len(itls)
                if itls else 0.0)
@@ -763,7 +772,7 @@ def _drive_serving(eng, prompts, n_clients, reqs_per_client, gen_len, mode,
             "evicted": evicted,
             "tokens_generated": counted,
             "tokens_evicted": evicted_tokens,
-            "throughput_tok_s": round(counted / wall, 2),
+            "throughput_tok_s": round(counted / max(wall, 1e-9), 2),
             "ttft_p50_s": round(pct(ttfts, 0.50), 4),
             "ttft_p95_s": round(pct(ttfts, 0.95), 4),
             "itl_p50_s": round(pct(itls, 0.50), 4),
@@ -773,6 +782,154 @@ def _drive_serving(eng, prompts, n_clients, reqs_per_client, gen_len, mode,
             "host_dispatches_per_token": round(dispatches / max(counted, 1),
                                                3),
             "req_stats": req_stats}
+
+
+def _drive_serving_sla(eng, prompts, n_clients, reqs_per_client, gen_len,
+                       uid_base, arrival_of=None, deadline=None,
+                       ttft_sla=None, rate_sla=None, capacity=None):
+    """Closed-loop clients over the SLA serving policy layer
+    (``inference/v2/serving.ServingSession``) — the third arm next to
+    ``_drive_serving``'s naive/splitfuse: admission control (queue/shed),
+    slack-ordered batch composition, lowest-slack KV preemption, and fused
+    K-step decode whenever every live stream is in steady state.
+
+    Returns the same result dict as ``_drive_serving`` plus a ``serve``
+    sub-dict (admitted/queued/shed/evicted counters and ``shed_pct``). A
+    shed request enters ``req_stats`` with zero tokens and the evicted flag
+    — an SLA miss — so goodput compares EQUAL offered load across arms;
+    graceful degradation shows up as shed_pct rising while goodput stays
+    above zero, instead of every stream missing together (r05 at 10
+    clients). Token timestamps come from the session's event stream; a
+    fused burst of k tokens lands at one instant and contributes k ITL
+    samples of delta/k (the amortized steady-state rate — per-token
+    intervals inside one device dispatch are not observable by design)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeedsyclsupport_tpu.inference.sampling import SamplingParams
+    from deepspeedsyclsupport_tpu.inference.v2 import (ServingPolicyConfig,
+                                                       ServingSession)
+
+    arrival_of = arrival_of or {}
+    have_sla = ttft_sla is not None or bool(rate_sla)
+    pol = ServingPolicyConfig(
+        admission="sla" if have_sla else "none",
+        ttft_sla_s=ttft_sla, token_rate_sla=rate_sla or 0.0,
+        shed_policy="queue", preempt_policy="reject",
+        max_queue_s=(4.0 * ttft_sla if ttft_sla else 60.0))
+    # `capacity` is SHARED across the sweep's arms: the solo calibration
+    # run measures real prefill/decode rates into it, so the admission gate
+    # at every load point projects from measurements, not priors
+    sess = ServingSession(eng, pol, capacity=capacity)
+
+    ttfts, itls = [], []
+    submitted, last_tok, gen_count, ttft_of = {}, {}, {}, {}
+    client_of = {}
+    next_req = [0] * n_clients
+    finished = evicted = shed = evicted_tokens = total_decoded = 0
+    stall_guard = 0
+    total = n_clients * reqs_per_client
+    req_stats = []
+    due = []  # (when, uid, client) arrivals not yet submitted
+
+    # pre-warm the sampler executable OUTSIDE the timed window (first-call
+    # compile must not land in the first TTFT/ITL samples)
+    eng.put([uid_base - 1], [[1, 2, 3]])
+    lg = eng.query(uid_base - 1)
+    sp = SamplingParams()
+    np.asarray(eng._sample_fn(jnp.stack([lg]), jax.random.PRNGKey(0),
+                              jnp.float32(sp.temperature),
+                              jnp.float32(sp.top_p), sp.structure))
+    eng.flush([uid_base - 1])
+    dispatches0 = getattr(eng, "host_dispatches", 0)
+
+    t0 = time.perf_counter()
+
+    def queue_next(c, when):
+        i = next_req[c]
+        next_req[c] += 1
+        uid = uid_base + c * 1000 + i
+        due.append((when, uid, c))
+        client_of[uid] = c
+
+    def record_done(uid, now, was_evicted):
+        nonlocal finished
+        finished += 1
+        req_stats.append((submitted[uid], now, gen_count.get(uid, 0),
+                          was_evicted, ttft_of.get(uid, 0.0)))
+        c = client_of[uid]
+        if next_req[c] < reqs_per_client:
+            queue_next(c, now)  # closed loop: next request on completion
+
+    for c in range(n_clients):
+        queue_next(c, t0 + arrival_of.get(uid_base + c * 1000 + 0, 0.0))
+
+    while finished < total:
+        now = time.perf_counter()
+        if deadline is not None and now > deadline:
+            raise _ScenarioTimeout(
+                f"sla: scenario deadline after {finished}/{total} requests "
+                f"({total_decoded} tokens, {shed} shed)")
+        for when, uid, c in [d for d in due if d[0] <= now]:
+            due.remove((when, uid, c))
+            submitted[uid] = max(now, when)
+            gen_count[uid] = 0
+            if sess.submit(uid, prompts[uid], gen_len, now=now) == "shed":
+                shed += 1
+                record_done(uid, now, was_evicted=True)
+        events = sess.step()
+        for ev in events:
+            if ev.kind == "token":
+                uid = ev.uid
+                n = len(ev.tokens)
+                if uid not in ttft_of:
+                    ttft_of[uid] = ev.t - submitted[uid]
+                    ttfts.append(ttft_of[uid])
+                    # tokens after the first in the SAME burst ride the
+                    # prefill drain: no ITL samples for them
+                else:
+                    itl = (ev.t - last_tok[uid]) / n
+                    itls.extend([itl] * n)
+                last_tok[uid] = ev.t
+                gen_count[uid] += n
+                total_decoded += n
+            elif ev.kind == "finish":
+                was_evicted = ev.reason == "evicted"
+                if was_evicted:
+                    evicted += 1
+                    evicted_tokens += gen_count.get(ev.uid, 0)
+                record_done(ev.uid, ev.t, was_evicted)
+            elif ev.kind == "shed":
+                shed += 1
+                record_done(ev.uid, ev.t, was_evicted=True)
+        if events:
+            stall_guard = 0
+            continue
+        if sess.idle and due:
+            wake = min(w for w, _u, _c in due)
+            if deadline is not None:
+                wake = min(wake, deadline)
+            time.sleep(max(0.0, wake - time.perf_counter()))
+            stall_guard = 0
+            continue
+        stall_guard += 1
+        if stall_guard > 200:
+            raise RuntimeError(
+                f"sla serving loop stalled: {sess.stats()}, "
+                f"{finished}/{total} done")
+    wall = time.perf_counter() - t0
+    res = _serving_result(wall, total, evicted, total_decoded,
+                          evicted_tokens, ttfts, itls,
+                          getattr(eng, "host_dispatches", 0) - dispatches0,
+                          req_stats)
+    st = sess.stats()
+    res["serve"] = {"admitted": st["admitted"], "queued": st["queued"],
+                    "shed": shed, "evicted": st["evicted"],
+                    "shed_pct": round(100.0 * shed / max(total, 1), 1),
+                    "prefill_tok_s_est": st["prefill_tok_s_est"],
+                    "decode_step_s_est": st["decode_step_s_est"]}
+    return res
 
 
 def _serve_once(model_name, platform, *, n_clients, reqs_per_client,
@@ -902,7 +1059,13 @@ def _serve_goodput_once(model_name, platform, *, client_sweep,
     cfg = get_config(model_name, max_seq_len=max_context)
     model = build_model(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
-    max_seqs = max(8, 2 * max(client_sweep))
+    # engine capacity is deliberately CAPPED below the heaviest sweep point:
+    # beyond-capacity load points (25/50 clients on CPU, 64 on TPU) are
+    # exactly the overload the admission gate must degrade gracefully under
+    # — and the padded forwards' per-step cost stays constant across the
+    # sweep, so light-load points are not taxed for the heavy ones
+    max_seqs = max(8, 2 * min(max(client_sweep),
+                              16 if platform == "tpu" else 10))
     extra = _attn_overrides(attn)
     eng = InferenceEngineV2(model, params,
                             config={"max_tokens_per_batch": budget,
@@ -911,6 +1074,16 @@ def _serve_goodput_once(model_name, platform, *, client_sweep,
                                     "max_sequences": max_seqs,
                                     "num_blocks": max_seqs
                                     * (max_context // block_size),
+                                    # SLA arm levers: fused K-step decode at
+                                    # the pre-seed K-sweep knee + slack-based
+                                    # KV eviction. max_prefill_fraction stays
+                                    # 1.0: on the CPU sim the fraction only
+                                    # SPREADS a prompt's fixed compute across
+                                    # more mixed forwards (same total decode
+                                    # stall, more dispatches) — admission is
+                                    # the overload valve, not chunk shrinking
+                                    "decode_steps_per_dispatch": 16,
+                                    "eviction_policy": "slack",
                                     **extra})
     rng = np.random.RandomState(0)
 
@@ -920,18 +1093,30 @@ def _serve_goodput_once(model_name, platform, *, client_sweep,
                                              size=prompt_len)]
                 for c in range(n_clients) for r in range(reqs_per_client)}
 
-    eng.warmup()
+    eng.warmup(fused_ladder=True)  # pre-compile every fused-K rung: a tail
+    # absorbing < K steps mid-sweep must not pay a compile inside a timed arm
     # ONE deadline covers calibration + sweep: the budget bounds the whole
     # call, not each phase separately
     sweep_end = (time.perf_counter() + sweep_budget_s
                  if sweep_budget_s else None)
-    # SLA calibration: solo client, splitfuse arm — median ITL sets the
-    # unloaded decode rate (SLA demands half of it, queue excluded), solo
-    # TTFT sets the first-token bound (SLA allows 3x: queueing headroom,
-    # the blog's latency-SLA shape)
+    # SLA calibration: solo client, PER-TOKEN splitfuse arm — median ITL
+    # sets the unloaded decode rate (SLA demands half of it, queue
+    # excluded), solo TTFT sets the first-token bound (SLA allows 5x:
+    # queueing headroom, the blog's latency-SLA shape). Per-token on
+    # purpose, twice over: it keeps the SLA thresholds comparable with the
+    # r05 baseline, and the fused-amortized solo ITL is 2-3x faster than
+    # any sustainable loaded step time — calibrating off it would demand a
+    # rate even graceful shedding cannot meet
     solo = _drive_serving(eng, prompts_for(9_000_000, 1), 1, 1,
                           gen_len, "splitfuse", 9_000_000,
                           deadline=sweep_end)
+    solo.pop("req_stats", None)
+    # seed the sweep-shared capacity model from the solo measurements so
+    # the first load point's admission gate projects from data, not priors
+    from deepspeedsyclsupport_tpu.inference.v2 import CapacityModel
+    capacity = CapacityModel()
+    capacity.record_prefill(prompt_len, max(solo["ttft_p50_s"], 1e-6))
+    capacity.record_decode(1, max(solo["itl_p50_s"], 1e-6))
     solo_rate = 1.0 / max(solo["itl_p50_s"], 1e-6)
     sla_rate = 0.5 * solo_rate
     # TTFT bound stays loose (5x solo): the discriminating bound is the
@@ -959,10 +1144,21 @@ def _serve_goodput_once(model_name, platform, *, client_sweep,
             arrivals = {uid_base + c * 1000 + 0: c * solo_span / n_clients
                         for c in range(n_clients)}
             try:
-                r = _drive_serving(eng, prompts_for(uid_base, n_clients),
-                                   n_clients, reqs_per_client, gen_len, mode,
-                                   uid_base, arrival_of=arrivals,
-                                   deadline=sweep_end)
+                if mode == "splitfuse":
+                    # the SplitFuse arm runs the full SLA policy layer:
+                    # admission (queue/shed vs the calibrated SLA), slack
+                    # scheduling, preemption, fused decode
+                    r = _drive_serving_sla(
+                        eng, prompts_for(uid_base, n_clients), n_clients,
+                        reqs_per_client, gen_len, uid_base,
+                        arrival_of=arrivals, deadline=sweep_end,
+                        ttft_sla=ttft_sla, rate_sla=sla_rate,
+                        capacity=capacity)
+                else:
+                    r = _drive_serving(eng, prompts_for(uid_base, n_clients),
+                                       n_clients, reqs_per_client, gen_len,
+                                       mode, uid_base, arrival_of=arrivals,
+                                       deadline=sweep_end)
             except _ScenarioTimeout as e:
                 timed_out = str(e)
                 break
@@ -970,6 +1166,8 @@ def _serve_goodput_once(model_name, platform, *, client_sweep,
                                 r["wall_s"])
             point[mode] = {"goodput_tok_s": round(gp, 2),
                            "sla_miss_pct": round(100 * miss, 1),
+                           "shed_pct": r.get("serve", {}).get("shed_pct",
+                                                              0.0),
                            "throughput_tok_s": r["throughput_tok_s"],
                            "ttft_p50_s": r["ttft_p50_s"],
                            "ttft_p95_s": r["ttft_p95_s"],
@@ -977,7 +1175,8 @@ def _serve_goodput_once(model_name, platform, *, client_sweep,
                            "itl_p95_s": r["itl_p95_s"],
                            "itl_std_s": r["itl_std_s"],
                            "host_dispatches_per_token":
-                               r["host_dispatches_per_token"]}
+                               r["host_dispatches_per_token"],
+                           **({"serve": r["serve"]} if "serve" in r else {})}
         if timed_out is not None:
             # the remaining (heavier) load points would also overrun:
             # stop the sweep, keep what completed
@@ -990,6 +1189,12 @@ def _serve_goodput_once(model_name, platform, *, client_sweep,
             break
         ratio = (point["splitfuse"]["goodput_tok_s"]
                  / max(point["naive"]["goodput_tok_s"], 1e-9))
+        if point["naive"]["goodput_tok_s"] <= 0 and ratio > 100.0:
+            # naive collapsed to zero goodput (the r05 overload signature):
+            # any survivor makes the raw ratio unbounded — cap it so the
+            # headline reads "graceful vs collapsed", not a fake 1e10x
+            ratio = 100.0
+            point["naive_collapsed"] = True
         point["goodput_ratio"] = round(ratio, 3)
         points.append(point)
         # flush the completed point NOW (partial line): a later kill —
@@ -1032,21 +1237,26 @@ def run_serve_goodput():
 
     platform = jax.devices()[0].platform
     if platform == "tpu":
+        # sweeps extend past engine capacity (max_sequences caps at 2x16):
+        # the 64-client point is pure overload — the admission gate's
+        # graceful-shedding territory
         ladder = [
-            dict(model_name="llama-650m", client_sweep=[4, 16, 32],
+            dict(model_name="llama-650m", client_sweep=[4, 16, 32, 64],
                  reqs_per_client=2, prompt_len=512, gen_len=64, budget=256,
                  block_size=64, max_context=1024),
             # XLA fallback if the Pallas serving path trips remote Mosaic
-            dict(model_name="llama-650m", client_sweep=[4, 16, 32],
+            dict(model_name="llama-650m", client_sweep=[4, 16, 32, 64],
                  reqs_per_client=2, prompt_len=512, gen_len=64, budget=256,
                  block_size=64, max_context=1024, attn="xla"),
-            dict(model_name="tiny", client_sweep=[4, 16, 32],
+            dict(model_name="tiny", client_sweep=[4, 16, 32, 64],
                  reqs_per_client=2, prompt_len=512, gen_len=64, budget=256,
                  block_size=64, max_context=1024),
         ]
     else:
         # budget « prompt so chunking matters (VERDICT r4 #3), scaled to
-        # what the CPU sim finishes inside the rung timeout
+        # what the CPU sim finishes inside the rung timeout; 25/50 clients
+        # run 1.25x/2.5x past the engine's 20-slot capacity — the fleet-
+        # scale overload points where shed_pct > 0 is the CORRECT outcome
         # NOTE on CPU-sim fidelity: a forward's wall time here scales
         # ~linearly with its token count, so a chunk-carrying fused forward
         # pays ~budget/decode-tokens more than a pure-decode forward — on
@@ -1054,7 +1264,7 @@ def run_serve_goodput():
         # which is the effect the SplitFuse headline rides. The CPU number
         # is therefore a structural UNDERestimate of the TPU ratio.
         ladder = [
-            dict(model_name="tiny", client_sweep=[2, 6, 10],
+            dict(model_name="tiny", client_sweep=[2, 6, 10, 25, 50],
                  reqs_per_client=1, prompt_len=512, gen_len=64, budget=96,
                  block_size=32, max_context=1024),
         ]

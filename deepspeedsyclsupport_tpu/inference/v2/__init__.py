@@ -10,13 +10,17 @@ scheduling:
 * :mod:`.model` — ragged forward over the paged cache (the role of the CUDA
   ``ragged_ops`` kernel set: ``linear_blocked_kv_rotary``, ``blocked_flash``,
   ``logits_gather``)
-* :mod:`.scheduler` — Dynamic SplitFuse token-budget scheduler
+* :mod:`.scheduler` — Dynamic SplitFuse token-budget scheduler with
+  slack-ordered (deadline-driven) chunk composition
 * :mod:`.engine_v2` — ``InferenceEngineV2`` with the ``put/query/flush/
   can_schedule`` contract (``inference/v2/engine_v2.py:107-237``)
+* :mod:`.serving` — SLA-aware serving policy layer (admission control,
+  capacity model, overload-graceful eviction; ``docs/serving.md``)
 """
-from .config import RaggedInferenceConfig  # noqa: F401
+from .config import RaggedInferenceConfig, ServingPolicyConfig  # noqa: F401
 from .engine_v2 import InferenceEngineV2  # noqa: F401
 from .ragged import BlockedAllocator, RaggedBatch, SequenceDescriptor  # noqa: F401
+from .serving import CapacityModel, ServeEvent, ServingSession  # noqa: F401
 
 
 def build_hf_engine(path: str, **config) -> "InferenceEngineV2":

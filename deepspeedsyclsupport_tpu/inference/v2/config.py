@@ -5,8 +5,8 @@ Analog of ``DSStateManagerConfig`` / ``RaggedInferenceEngineConfig``
 geometry, ragged batch budgets, sequence limits.
 """
 import math
-from dataclasses import dataclass
-from typing import Any, Dict, Optional
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Union
 
 import jax.numpy as jnp
 
@@ -43,7 +43,8 @@ class RaggedInferenceConfig:
     # also decodes (ITL protection under prompt bursts; 1.0 = off)
     max_prefill_fraction: float = 1.0
     # KV-pressure eviction victim: longest_context (truncation-biased,
-    # default) | lru (least-recently-scheduled) | newest (LIFO backoff)
+    # default) | lru (least-recently-scheduled) | newest (LIFO backoff) |
+    # slack (least SLA slack — most likely to miss anyway; docs/serving.md)
     eviction_policy: str = "longest_context"
     # steady-state decode fusion: when every live sequence is decoding and
     # nothing is waiting, run up to this many decode steps (forward +
@@ -71,9 +72,10 @@ class RaggedInferenceConfig:
         if not 0.0 < self.max_prefill_fraction <= 1.0:
             raise ValueError(f"max_prefill_fraction must be in (0, 1], got "
                              f"{self.max_prefill_fraction}")
-        if self.eviction_policy not in ("longest_context", "lru", "newest"):
+        if self.eviction_policy not in ("longest_context", "lru", "newest",
+                                        "slack"):
             raise ValueError(f"eviction_policy must be longest_context|lru|"
-                             f"newest, got {self.eviction_policy!r}")
+                             f"newest|slack, got {self.eviction_policy!r}")
         if self.atom_q_size is None:
             self.atom_q_size = min(128, self.max_tokens_per_batch)
         if self.atom_q_size < 1:
@@ -107,4 +109,90 @@ class RaggedInferenceConfig:
         unknown = set(cfg) - known
         if unknown:
             raise ValueError(f"unknown ragged config keys: {sorted(unknown)}")
+        return cls(**cfg)
+
+
+@dataclass
+class ServingPolicyConfig:
+    """SLA serving-policy knobs (``serving.ServingSession`` — see
+    ``docs/serving.md`` for the overload-behavior contract these encode).
+
+    The reference's FastGen SLA is two-part per request: first token within
+    a TTFT bound AND a sustained decode token rate. Under overload the
+    policy's job is to keep the *admitted* streams meeting that SLA by
+    queueing or shedding new arrivals, preempting the lowest-slack stream
+    when the KV pool exhausts, and ordering work by slack — instead of the
+    admit-everyone collapse (r05: 100% SLA miss at 10 clients).
+    """
+
+    # --- admission gate -------------------------------------------------
+    admission: str = "sla"     # "sla" (project deadlines) | "none" (FIFO —
+    #                            queue on structural limits only)
+    ttft_sla_s: Optional[float] = None  # default TTFT deadline per request
+    #                                     (None = requests carry no deadline
+    #                                     unless submit() sets one)
+    token_rate_sla: float = 0.0   # per-stream decode tokens/s target
+    shed_policy: str = "queue"    # "queue": hold unadmittable requests until
+    #                               their deadline is provably unmeetable;
+    #                               "reject": shed immediately when not
+    #                               admissible at submit time
+    max_queue_s: float = 30.0     # queued longer than this is shed outright
+    sla_headroom: float = 1.15    # safety factor on projected service times
+    rate_feasibility_margin: float = 0.8  # shed on rate ONLY when the
+    #   measured per-stream decode rate is clearly below the SLA
+    #   (measured < margin * required): the EWMA breathes several percent
+    #   under load, and a borderline stream still delivers ~SLA — TTFT
+    #   projection is the overload valve, this check only catches
+    #   hardware-can-never-do-it targets
+    # --- overload eviction ---------------------------------------------
+    preempt_policy: str = "reject"  # KV-exhaustion victim handling:
+    #                                 "reject" (finish with partial output) |
+    #                                 "requeue" (re-prefill later; its SLA is
+    #                                 re-projected at re-admission)
+    # --- batch composition ----------------------------------------------
+    tenant_token_budget: Optional[Union[int, Dict[str, int]]] = None
+    #   max prefill tokens one tenant may take per scheduling round (int =
+    #   every tenant; dict keys tenants, "*" = default; None = no cap)
+    aging_weight: float = 2.0     # starvation aging: seconds of slack credit
+    #                               per second a chunk waits unserved
+    # --- capacity model (EWMA priors; measured values take over) --------
+    ewma_alpha: float = 0.25
+    prefill_tok_s_prior: float = 1000.0
+    decode_step_s_prior: float = 0.05
+    # telemetry: emit Serve/* metrics through monitor.telemetry
+    telemetry: bool = True
+    extra: Dict[str, Any] = field(default_factory=dict)  # forward-compat bag
+
+    def __post_init__(self):
+        if self.admission not in ("sla", "none"):
+            raise ValueError(f"admission must be sla|none, got "
+                             f"{self.admission!r}")
+        if self.shed_policy not in ("queue", "reject"):
+            raise ValueError(f"shed_policy must be queue|reject, got "
+                             f"{self.shed_policy!r}")
+        if self.preempt_policy not in ("reject", "requeue"):
+            raise ValueError(f"preempt_policy must be reject|requeue, got "
+                             f"{self.preempt_policy!r}")
+        if not 0.0 < self.ewma_alpha <= 1.0:
+            raise ValueError(f"ewma_alpha must be in (0, 1], got "
+                             f"{self.ewma_alpha}")
+        if self.sla_headroom < 1.0:
+            raise ValueError(f"sla_headroom must be >= 1.0, got "
+                             f"{self.sla_headroom}")
+        if not 0.0 < self.rate_feasibility_margin <= 1.0:
+            raise ValueError(f"rate_feasibility_margin must be in (0, 1], "
+                             f"got {self.rate_feasibility_margin}")
+        if self.ttft_sla_s is not None and self.ttft_sla_s <= 0:
+            raise ValueError(f"ttft_sla_s must be positive, got "
+                             f"{self.ttft_sla_s}")
+
+    @classmethod
+    def from_config(cls, config: Optional[Dict] = None, **kw):
+        cfg = dict(config or {})
+        cfg.update(kw)
+        known = set(cls.__dataclass_fields__)
+        unknown = set(cfg) - known
+        if unknown:
+            raise ValueError(
+                f"unknown serving policy keys: {sorted(unknown)}")
         return cls(**cfg)

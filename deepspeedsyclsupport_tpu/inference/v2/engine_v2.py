@@ -11,6 +11,7 @@ ONE jitted ragged forward (QKV+RoPE+paged-append, blocked attention, MLP,
 logits gather) → last-token logits land back in each sequence descriptor.
 """
 import dataclasses
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -82,6 +83,10 @@ class InferenceEngineV2:
         self.kv = init_blocked_kv(model.config, cfg)
         self.allocator = BlockedAllocator(cfg.num_blocks)
         self.seqs: Dict[int, SequenceDescriptor] = {}
+        # SLA layer (serving.ServingSession) installs a scheduler.SlackPolicy
+        # here; put() then orders chunks by slack instead of arrival. None =
+        # the pre-SLA least-recently-served ordering.
+        self.slack_policy = None
         self._tick = 0  # forward counter (LRU eviction / prefill fairness)
         self._forward = build_ragged_forward_fn(model, cfg.block_size,
                                                 attn_impl=cfg.prefill_attn)
@@ -185,14 +190,18 @@ class InferenceEngineV2:
                    topology=topology)
 
     # --------------------------------------------------------------- warmup
-    def warmup(self) -> None:
+    def warmup(self, fused_ladder: bool = False) -> None:
         """Compile the prefill and decode programs in BOTH KV-sharding
         states before serving. The first jitted forward returns a donated
         KV cache whose sharding differs from ``init_blocked_kv``'s
         placement, so each program's SECOND call in that state is the one
         that compiles the steady-state variant — without this, the first
         real requests pay two spurious recompiles (measured ~1.7s each on
-        the CPU sim; worse on TPU)."""
+        the CPU sim; worse on TPU). ``fused_ladder=True`` additionally
+        compiles EVERY fused-decode rung {K/2, ..., 2}, not just K — a
+        serving bench must not pay a mid-run compile when a short tail
+        first selects a smaller rung (off by default: tests and callers
+        that never hit the fused path shouldn't pay log2(K) compiles)."""
         cfg = self.config
         uid = -(1 << 40) - 1   # reserved: below any sane caller uid
         # leave room for the 4 follow-up tokens within max_context
@@ -228,6 +237,25 @@ class InferenceEngineV2:
                 log_dist(f"warmup: fused decode program (K={k}) not "
                          f"pre-compiled — KV pool too small to pre-fund it; "
                          f"first steady-state generate() will compile")
+            if fused_ladder:
+                # mirror the serve-time rung sequence (max(2, rung // 2)
+                # stepping) so EVERY program the dispatch can select is
+                # compiled here — for non-power-of-two K the naive
+                # `rung //= 2` walk skips the 2-rung the pressure
+                # fallback snaps to
+                rung = k
+                while rung > 2:
+                    rung = max(2, rung // 2)
+                    self.flush([uid])
+                    self.put([uid], [[2]])
+                    # k_cap pins the ladder top at `rung`, forcing its
+                    # compile (a bare budget of `rung` steps would be
+                    # routed back to the already-compiled K program by
+                    # the prefer-compiled rung walk)
+                    self._decode_multi_dispatch({uid: rung},
+                                                SamplingParams(), None,
+                                                jax.random.PRNGKey(0),
+                                                k_cap=rung)
         self.flush([uid])
         self.host_dispatches = 0  # counter measures serving, not warmup
 
@@ -324,13 +352,16 @@ class InferenceEngineV2:
                 max_tokens=cfg.max_tokens_per_batch,
                 max_sequences=cfg.max_sequences, block_size=cfg.block_size,
                 max_context=cfg.max_context,
-                max_prefill_fraction=cfg.max_prefill_fraction)
+                max_prefill_fraction=cfg.max_prefill_fraction,
+                policy=self.slack_policy)
             if not chunks:
                 break
             logits = self._run(chunks)
             self._tick += 1
+            served_s = time.perf_counter()  # aging base for slack ordering
             for slot, (d, n) in enumerate(chunks):
                 d.last_scheduled = self._tick
+                d.last_service_s = served_s
                 del d.pending[:n]
                 d.n_cached += n
                 if not d.pending:
@@ -346,7 +377,10 @@ class InferenceEngineV2:
         """Victim index under the configured ``eviction_policy``:
         longest_context truncates the sequence closest to done anyway; lru
         sheds whoever the scheduler served least recently; newest backs off
-        the latest admit (LIFO — protects old sequences' sunk KV cost)."""
+        the latest admit (LIFO — protects old sequences' sunk KV cost);
+        slack sheds the sequence with the least SLA slack — it is the most
+        likely to miss its deadline anyway, so freeing its blocks preserves
+        the goodput of the rest (ties fall back to longest context)."""
         policy = self.config.eviction_policy
         if policy == "lru":
             return min(range(len(uids)),
@@ -354,8 +388,47 @@ class InferenceEngineV2:
         if policy == "newest":
             return max(range(len(uids)),
                        key=lambda i: self.seqs[uids[i]].last_scheduled)
+        if policy == "slack":
+            from .scheduler import slack_of
+
+            now = time.perf_counter()
+            return min(range(len(uids)),
+                       key=lambda i: (slack_of(self.seqs[uids[i]], now),
+                                      -self.seqs[uids[i]].n_cached))
         return max(range(len(uids)),
                    key=lambda i: self.seqs[uids[i]].n_cached)
+
+    def ensure_seq(self, uid: int, **fields) -> SequenceDescriptor:
+        """Create (or fetch) the descriptor for ``uid`` and set SLA fields
+        (deadline_s, rate_sla, tenant, ...) BEFORE any tokens are enqueued —
+        the serving layer's hook so the very first scheduler pass already
+        orders this sequence by its slack. Unknown fields raise."""
+        d = self.seqs.get(uid)
+        if d is None:
+            d = self.seqs[uid] = SequenceDescriptor(uid=uid)
+        for name, value in fields.items():
+            if not hasattr(d, name):
+                raise AttributeError(
+                    f"SequenceDescriptor has no SLA field {name!r}")
+            setattr(d, name, value)
+        return d
+
+    def preempt(self, uid: int) -> Optional[SequenceDescriptor]:
+        """Overload-graceful eviction: release ``uid``'s KV blocks and slot
+        but RETURN the descriptor (emitted count and SLA budget intact, KV
+        state reset) so the serving layer can requeue it for a fresh prefill
+        or reject it with partial output — instead of the whole batch
+        stalling on an exhausted pool."""
+        d = self.seqs.pop(uid, None)
+        if d is None:
+            return None
+        self.allocator.free(d.blocks)
+        d.blocks = []
+        d.n_cached = 0
+        d.pending.clear()
+        d.last_logits = None
+        d.last_scheduled = -1
+        return d
 
     def _run(self, chunks) -> jax.Array:
         cfg = self.config
@@ -424,7 +497,9 @@ class InferenceEngineV2:
     def _decode_multi_dispatch(self, running: Dict[int, int],
                                sp: "SamplingParams",
                                eos_token_id: Optional[int],
-                               rng: jax.Array) -> Optional[Dict[int, List[int]]]:
+                               rng: jax.Array,
+                               k_cap: Optional[int] = None
+                               ) -> Optional[Dict[int, List[int]]]:
         """Steady-state fused decode: up to K tokens per live sequence in ONE
         device dispatch (``model.decode_multi_forward``).
 
@@ -433,6 +508,17 @@ class InferenceEngineV2:
         flushed. Returns {uid: emitted tokens} — or ``None`` when the KV pool
         cannot pre-fund ≥2 steps for the worst case, in which case the caller
         falls back to the per-token path (which evicts under pressure).
+
+        K selection walks the compiled ladder {K, K/2, ..., 2} (bounding the
+        program cache to log2(K) entries) and picks the smallest rung
+        covering the LARGEST number of steps any live sequence can still
+        absorb (budget ∧ context headroom) — one dispatch drains the whole
+        tail even below full occupancy, where the old fixed-K gate left the
+        per-token path paying a host round trip per token
+        (``host_dispatches_per_token`` ≈ 0.77 at light load, r05). Overshoot
+        is cheap: the device loop exits as soon as every slot retires.
+        ``k_cap`` lets a serving layer bound the dispatch (e.g. to the slack
+        of a queued request) without forking the ladder.
 
         KV blocks for the worst-case K appends are allocated up front so the
         block tables are loop-invariant on device; a retiring sequence's
@@ -443,6 +529,30 @@ class InferenceEngineV2:
         cfg = self.config
         uids = list(running)
         k = cfg.decode_steps_per_dispatch
+        if k_cap is not None:
+            cap = max(2, int(k_cap))
+            while k > 2 and k > cap:
+                k = max(2, k // 2)  # snap DOWN the rung ladder: an
+                #   arbitrary cap value must select a compiled program,
+                #   never compile a fresh K mid-serve (floor 2: an odd
+                #   rung halving to 1 would silently disable fusion)
+        absorb = max((min(running[u],
+                          max(0, cfg.max_context - self.seqs[u].n_cached))
+                      for u in uids), default=0)
+        if absorb < 1:
+            return None
+        # rung ladder {k, ..., 2}: snap to the smallest rung covering the
+        # longest tail, then prefer the smallest ALREADY-COMPILED rung —
+        # an uncompiled smaller program is never worth a mid-run compile
+        # (the larger program early-exits once every slot retires), and a
+        # plain-warmup() caller only has K itself compiled
+        ladder = [k]
+        while ladder[-1] > 2:
+            ladder.append(max(2, ladder[-1] // 2))
+        i = max((j for j, r in enumerate(ladder) if r >= absorb), default=0)
+        while i > 0 and (ladder[i], sp.structure) not in self._decode_multi:
+            i -= 1
+        k = ladder[i]
 
         def _wants(k_steps: int) -> List[int]:
             out = []
@@ -497,11 +607,14 @@ class InferenceEngineV2:
         act_h = np.asarray(act_f)
         sl_h = np.asarray(sl_f)
         emitted: Dict[int, List[int]] = {}
+        served_s = time.perf_counter()
         for i, u in enumerate(uids):
             d = self.seqs[u]
             emitted[u] = [int(t) for t in toks[:, i] if t >= 0]
             d.n_cached = int(pos_h[i])
             d.last_scheduled = self._tick
+            d.last_service_s = served_s
+            d.emitted += len(emitted[u])
             if act_h[i]:
                 running[u] = int(sl_h[i])
                 d.last_logits = logits_f[i]

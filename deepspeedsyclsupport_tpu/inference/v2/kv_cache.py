@@ -9,6 +9,7 @@ scatter, which XLA lowers to efficient dynamic-slice traffic on TPU.
 from typing import NamedTuple
 
 import jax.numpy as jnp
+import numpy as np
 
 from .config import RaggedInferenceConfig
 
@@ -46,3 +47,18 @@ def init_blocked_kv(model_config, cfg: RaggedInferenceConfig) -> BlockedKV:
     shape = (model_config.num_layers, cfg.num_blocks * cfg.block_size,
              model_config.num_kv_heads, d)
     return BlockedKV(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+
+def kv_pool_stats(kv: BlockedKV, allocator) -> dict:
+    """Occupancy + footprint of the paged pool, shape-only (no host sync):
+    the ``Serve/kv_occupancy`` gauge's source and the operator's answer to
+    "is the pool the bottleneck" — ``occupancy`` is the fraction of blocks
+    sequences currently own; ``pool_bytes`` counts BOTH k and v arrays at
+    the (possibly lane-padded) allocated head dim."""
+    total = allocator.num_blocks
+    free = allocator.free_blocks
+    per_slot = int(np.prod(kv.k.shape[2:])) * kv.k.dtype.itemsize \
+        * kv.k.shape[0]
+    return {"blocks_total": total, "blocks_free": free,
+            "occupancy": 1.0 - free / total,
+            "pool_bytes": 2 * per_slot * kv.num_slots}

@@ -61,6 +61,18 @@ class SequenceDescriptor:
     last_logits: Optional[np.ndarray] = None          # set when pending drains
     last_scheduled: int = -1   # engine forward-tick of the last chunk (LRU
     #                            eviction + prefill round-robin fairness)
+    # --- SLA budget (serving.py admission gate / scheduler slack ordering).
+    # All timestamps share one monotonic clock base (time.perf_counter by
+    # default — the session's ``clock``); absolute wall time never enters.
+    arrival_s: float = 0.0          # when the request was submitted
+    deadline_s: Optional[float] = None  # absolute TTFT deadline (None = no SLA)
+    rate_sla: float = 0.0           # required decode tokens/s (0 = none)
+    tenant: str = "default"         # fairness-budget key
+    target_new_tokens: int = 0      # requested generation length
+    emitted: int = 0                # decode tokens delivered so far
+    first_token_s: Optional[float] = None  # when the first token landed
+    last_service_s: float = -1.0    # clock stamp of the last scheduled chunk
+    #                                 (starvation aging in slack ordering)
 
     @property
     def needs_tokens(self) -> int:

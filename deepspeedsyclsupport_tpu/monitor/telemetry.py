@@ -112,7 +112,14 @@ EVENT_NAMES = frozenset(
      "Goodput/productive_frac",
      "Memory/bytes_in_use", "Memory/peak_bytes_in_use",
      "Compile/count", "Compile/total_s",
-     "Ckpt/save_s", "Ckpt/bytes_written"}
+     "Ckpt/save_s", "Ckpt/bytes_written",
+     # SLA serving policy (inference/v2/serving.py — admission gate,
+     # slack scheduler, KV-pressure eviction; docs/serving.md): queue
+     # depth / KV-pool occupancy / live-stream gauges, admission outcome
+     # counters, and TTFT/ITL latency histograms
+     "Serve/queue_depth", "Serve/kv_occupancy", "Serve/live_seqs",
+     "Serve/admitted", "Serve/queued", "Serve/shed", "Serve/evicted",
+     "Serve/completed", "Serve/ttft_s", "Serve/itl_s"}
     | {f"Resilience/{n}" for n in ResilienceCounters.NAMES})
 
 #: Families whose member names are data-dependent (collective op mix, user
