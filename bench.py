@@ -547,6 +547,58 @@ def run_train():
 
 
 # ======================================================================
+# rung: multichip (pod-scope comm/compute decomposition on the CPU sim)
+# ======================================================================
+def run_multichip():
+    """8-virtual-device ZeRO-3 training with per-rank flight recorders and
+    the static collective census, fused by ``monitor/pod.py``: the emitted
+    ``comm_bound_frac`` + per-traffic-class effective bandwidth are the
+    before/after axis the quantized-collectives work (EQuARX, ZeRO++ qwZ/
+    qgZ) A-Bs against — byte totals in the table match the static census
+    exactly, so a quantized arm shows up as a bytes (and bandwidth-demand)
+    drop at equal step semantics."""
+    import importlib.util
+    import tempfile
+
+    n = int(os.environ.get("DSTPU_MULTICHIP_DEVICES", "8"))
+    # no XLA_FLAGS juggling here: pod_leg's _force_cpu_if_needed sets the
+    # virtual device count before this child's first jax import
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "__graft_entry__.py"))
+    graft = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(graft)
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory(prefix="dstpu_bench_pod_") as td:
+        report = graft.pod_leg(n, os.path.join(td, "telemetry"), steps=6,
+                               emit_metrics_line=False)
+    dec = report["decomposition"]
+    import jax
+
+    _emit({
+        "metric": "multichip_comm_bound_frac",
+        "value": round(dec["comm_bound_frac"] or 0.0, 4),
+        "unit": "frac", "vs_baseline": None,
+        "detail": {
+            "platform": jax.devices()[0].platform,
+            "n_devices": n,
+            "n_steps": report["n_steps"],
+            "ranks": len(report["ranks"]),
+            "per_class_bandwidth_gbps": {
+                cls: row["effective_gbps"]
+                for cls, row in dec["classes"].items()},
+            "class_bytes_per_step": {
+                cls: row["bytes_per_step"]
+                for cls, row in dec["classes"].items()},
+            "exposed_comm_s": dec["exposed_comm_s"],
+            "compute_floor_s": dec["compute_floor_s"],
+            "census_bytes_match": report["census"]["bytes_match"],
+            "skew_p95_s": report["skew"]["p95"],
+            "wall_s": round(time.perf_counter() - t0, 1),
+        }})
+
+
+# ======================================================================
 # rung: serve (FastGen-style TTFT / throughput, SplitFuse A-B)
 # ======================================================================
 def _drive_serving(eng, prompts, n_clients, reqs_per_client, gen_len, mode,
@@ -1673,17 +1725,22 @@ class _ProbeWatcher:
         self._stop.set()
 
 
+# multichip is the CPU virtual-device sim by construction — it runs under
+# CPU_ENV on both plans (on a TPU window it still measures the SPMD sim,
+# not the silicon, and is priced accordingly at the tail of the plan)
 TPU_PLAN = [("kernels_micro", 400, {}, False),
             ("kernels", 600, {}, False),
             ("train", 1200, {}, True),
             ("serve", 700, {}, True),
             ("serve_fused", 500, {}, True),
-            ("serve_goodput", 700, {}, True)]
+            ("serve_goodput", 700, {}, True),
+            ("multichip", 400, CPU_ENV, False)]
 CPU_PLAN = [("kernels_aot", 400, CPU_ENV, False),
             ("serve", 500, CPU_ENV, False),
             ("serve_fused", 400, CPU_ENV, False),
             ("serve_goodput", 700, CPU_ENV, False),
-            ("train", 700, CPU_ENV, False)]
+            ("train", 700, CPU_ENV, False),
+            ("multichip", 400, CPU_ENV, False)]
 
 
 class _Killed(Exception):
@@ -1878,6 +1935,8 @@ if __name__ == "__main__":
         run_serve_fused()
     elif rung == "serve_goodput":
         run_serve_goodput()
+    elif rung == "multichip":
+        run_multichip()
     else:
         main()
         sys.exit(0)

@@ -128,19 +128,32 @@ class DSElasticAgent:
         }
 
     # ------------------------------------------------------------- heartbeat
+    def _heartbeat_files(self) -> List[str]:
+        """``heartbeat_file`` may be a glob (``heartbeat_rank*.json``) so a
+        multi-rank local job is watched pod-wide — telemetry writes one
+        freshness file PER RANK, and under SPMD one hung rank hangs every
+        rank at the next collective."""
+        import glob
+
+        if self.heartbeat_file and glob.has_magic(self.heartbeat_file):
+            return sorted(glob.glob(self.heartbeat_file))
+        return [self.heartbeat_file] if self.heartbeat_file else []
+
     def _heartbeat_stale(self, launched_at: float) -> bool:
         from ..monitor.telemetry import Heartbeat
 
-        age = Heartbeat.age(self.heartbeat_file)
-        if age is None:
+        ages = [Heartbeat.age(p) for p in self._heartbeat_files()]
+        ages = [a for a in ages if a is not None]
+        if not ages:
             # no beat yet: a worker that hangs in init (distributed setup,
             # first compile) never writes one — count staleness from launch.
             # Enabling the watch therefore REQUIRES worker telemetry
             # heartbeats; size the timeout to cover startup + first compile.
             # launched_at is monotonic: an NTP step during init must not
             # spuriously declare (or mask) a hang.
-            age = time.monotonic() - launched_at
-        return age > self.heartbeat_timeout
+            ages = [time.monotonic() - launched_at]
+        # the STALEST rank decides: one hung rank is a hung pod
+        return max(ages) > self.heartbeat_timeout
 
     def _launch(self, env: Dict[str, str]) -> int:
         """Run one worker attempt. Without a heartbeat watch this is a plain
@@ -155,10 +168,11 @@ class DSElasticAgent:
         # a leftover heartbeat from the previous incarnation is stale by
         # definition — without this every relaunch would be declared hung
         # (and killed) before the fresh worker reaches its first beat
-        try:
-            os.unlink(self.heartbeat_file)
-        except OSError:
-            pass
+        for path in self._heartbeat_files():
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
         launched_at = time.monotonic()
         proc = subprocess.Popen(self.cmd, env=env)
         while True:
@@ -284,7 +298,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "gives up (default: unbounded)")
     ap.add_argument("--heartbeat-file", default=None,
                     help="telemetry heartbeat file to watch (the worker's "
-                         "telemetry_logs/heartbeat_rank0.json)")
+                         "telemetry_logs/heartbeat_rank0.json); a glob like "
+                         "'telemetry_logs/heartbeat_rank*.json' watches every "
+                         "rank — the stalest one decides (one hung rank is a "
+                         "hung pod)")
     ap.add_argument("--heartbeat-timeout", type=float, default=None,
                     help="seconds of heartbeat staleness before the worker "
                          "is declared hung (stack-dumped via SIGUSR1, then "

@@ -647,7 +647,11 @@ class ServingSession:
 
     def summary_events(self, step: Optional[int] = None) -> List[Tuple]:
         """Scalar ``Serve/*`` events for a MonitorMaster print boundary —
-        validated against the telemetry registry (strict mode safe)."""
+        validated against the telemetry registry (strict mode safe).
+        TTFT/ITL histograms surface their estimated p50/p95/p99 (bucket-
+        interpolated, ``Histogram.quantile``) alongside the raw bucket
+        counts the registry already holds — the scalar a dashboard or the
+        pod report's skew table actually wants."""
         from ...monitor.telemetry import check_events
 
         ev = [(f"Serve/{n}", float(v), step)
@@ -655,4 +659,12 @@ class ServingSession:
         ev += [("Serve/queue_depth", float(len(self.queue)), step),
                ("Serve/live_seqs", float(len(self.running)), step),
                ("Serve/kv_occupancy", self._kv_occupancy(), step)]
+        if self._metrics is not None:
+            for name in SERVE_HISTOGRAMS:
+                hist = self._metrics.histogram(name)
+                if not hist.count:
+                    continue
+                for q, value in hist.quantiles().items():
+                    if value is not None:
+                        ev.append((f"{name}/{q}", float(value), step))
         return check_events(ev)

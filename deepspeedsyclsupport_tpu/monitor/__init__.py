@@ -2,6 +2,9 @@ from .monitor import (CsvMonitor, JsonlMonitor, Monitor, MonitorMaster,
                       ResilienceCounters, TensorBoardMonitor, WandbMonitor,
                       csv_filename_for_event, event_for_csv_filename,
                       resilience_counters)
+from .pod import (PodReport, RankStream, discover_rank_files, fuse_pod,
+                  load_rank_streams, pod_report_from_paths,
+                  validate_pod_report)
 from .telemetry import (EVENT_NAME_RE, EVENT_NAMES, EVENT_PREFIXES,
                         FlightRecorder, GoodputAccounter, Heartbeat,
                         MetricsRegistry, Telemetry, UndeclaredEventError,

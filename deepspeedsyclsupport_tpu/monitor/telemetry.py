@@ -46,6 +46,10 @@ from collections import deque
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from ..utils.logging import logger
+# shared fixed-bucket helpers live in the stdlib-only pod module (the
+# offline CLIs load THAT file standalone on jax-less nodes, so the import
+# must point this way — pod never imports telemetry)
+from .pod import DURATION_BUCKETS_S, histogram_quantile  # noqa: F401
 
 Event = Tuple[str, Any, int]
 
@@ -120,11 +124,15 @@ EVENT_NAMES = frozenset(
      "Serve/queue_depth", "Serve/kv_occupancy", "Serve/live_seqs",
      "Serve/admitted", "Serve/queued", "Serve/shed", "Serve/evicted",
      "Serve/completed", "Serve/ttft_s", "Serve/itl_s"}
+    | {f"Serve/{h}/{q}" for h in ("ttft_s", "itl_s")
+       for q in ("p50", "p95", "p99")}
     | {f"Resilience/{n}" for n in ResilienceCounters.NAMES})
 
 #: Families whose member names are data-dependent (collective op mix, user
-#: extensions). A prefix declares the whole family.
-EVENT_PREFIXES = ("Comm/", "Custom/")
+#: extensions, pod-scope aggregates whose per-class / per-rank member names
+#: depend on the parallelism layout — see ``monitor/pod.py``). A prefix
+#: declares the whole family.
+EVENT_PREFIXES = ("Comm/", "Custom/", "Pod/")
 
 _extra_event_names: set = set()
 _warned_names: set = set()
@@ -182,10 +190,6 @@ def check_events(events: List[Event]) -> List[Event]:
 # =========================================================================
 # Metrics registry
 # =========================================================================
-
-#: Default histogram buckets for durations in seconds (5 ms … 2 min).
-DURATION_BUCKETS_S = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
-                      5.0, 10.0, 30.0, 60.0, 120.0)
 
 
 class Counter:
@@ -260,6 +264,21 @@ class Histogram:
         with self._lock:
             return {"buckets": list(self.buckets), "counts": list(self.counts),
                     "sum": self._sum, "count": self._count}
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimated q-quantile (0 < q ≤ 1) from the fixed buckets: linear
+        interpolation inside the bucket the target observation falls in.
+        Resolution is the bucket width; an estimate landing in the +inf
+        overflow bucket returns the highest finite edge (a floor, flagged by
+        callers that care). ``None`` with no observations."""
+        with self._lock:
+            counts, total = list(self.counts), self._count
+        return histogram_quantile(self.buckets, counts, total, q)
+
+    def quantiles(self, qs: Iterable[float] = (0.5, 0.95, 0.99)
+                  ) -> Dict[str, Optional[float]]:
+        """{"p50": …, "p95": …, "p99": …} estimates (see :meth:`quantile`)."""
+        return {f"p{int(round(q * 100))}": self.quantile(q) for q in qs}
 
 
 class MetricsRegistry:
@@ -611,6 +630,76 @@ class Heartbeat:
                 else time.time()) - float(hb["t"])  # dslint: allow(wall-clock-in-step-path)
 
 
+# =========================================================================
+# Prometheus textfile rendering (export_textfile)
+# =========================================================================
+
+_PROM_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prometheus_name(name: str) -> str:
+    """Metric-registry name → Prometheus metric name (``Serve/ttft_s`` →
+    ``dstpu_Serve_ttft_s``)."""
+    out = _PROM_BAD_CHARS.sub("_", name)
+    if not out or out[0].isdigit():
+        out = "_" + out
+    return f"dstpu_{out}"
+
+
+def render_prometheus(snapshot: Dict[str, Any],
+                      labels: Optional[Dict[str, str]] = None) -> str:
+    """Render a :meth:`MetricsRegistry.snapshot` (plus any extra scalar maps
+    merged into its ``counters``/``gauges``) as Prometheus text exposition
+    format — the textfile-collector contract: a node exporter (or any
+    scraper) reads the file, so long multi-host runs are observable without
+    ever parsing JSONL."""
+    label_str = ""
+    if labels:
+        inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        label_str = "{" + inner + "}"
+    lines: List[str] = []
+    for name, value in sorted(snapshot.get("counters", {}).items()):
+        pname = prometheus_name(name)
+        lines.append(f"# TYPE {pname} counter")
+        lines.append(f"{pname}{label_str} {value}")
+    for name, value in sorted(snapshot.get("gauges", {}).items()):
+        pname = prometheus_name(name)
+        lines.append(f"# TYPE {pname} gauge")
+        lines.append(f"{pname}{label_str} {value}")
+    for name, h in sorted(snapshot.get("histograms", {}).items()):
+        pname = prometheus_name(name)
+        lines.append(f"# TYPE {pname} histogram")
+        cum = 0
+        for edge, count in zip(h["buckets"], h["counts"]):
+            cum += count
+            le = ("{" + (label_str[1:-1] + "," if label_str else "")
+                  + f'le="{edge}"' + "}")
+            lines.append(f"{pname}_bucket{le} {cum}")
+        cum += h["counts"][-1]
+        le_inf = ("{" + (label_str[1:-1] + "," if label_str else "")
+                  + 'le="+Inf"' + "}")
+        lines.append(f"{pname}_bucket{le_inf} {cum}")
+        lines.append(f"{pname}_sum{label_str} {h['sum']}")
+        lines.append(f"{pname}_count{label_str} {h['count']}")
+    return "\n".join(lines) + "\n"
+
+
+_anchor_lock = threading.Lock()
+_anchor_counter = 0
+
+
+def _next_anchor_seq() -> int:
+    """Process-global anchor epoch counter: two anchored engines in one
+    process must stamp DISTINCT sync epochs or their step spans would
+    collide on the pod aggregator's (sync, step) fusion keys. Ranks stay in
+    lockstep because :meth:`Telemetry.anchor` is a collective — every rank
+    performs the same anchor calls in the same order."""
+    global _anchor_counter
+    with _anchor_lock:
+        _anchor_counter += 1
+        return _anchor_counter
+
+
 _faulthandler_installed = False
 
 
@@ -664,6 +753,11 @@ class Telemetry:
         self._last_memory_step = -1
         self._last_step_end: Optional[float] = None
         self._step_hist = self.registry.histogram("step_time_s")
+        # latest anchor epoch THIS telemetry stamped on its step spans; the
+        # counter behind it is process-global (_next_anchor_seq) so two
+        # anchored engines in one process get distinct epochs
+        self._anchor_seq = 0
+        self._last_textfile: Optional[float] = None
         self.heartbeat: Optional[Heartbeat] = None
         if cfg.heartbeat_enabled:
             self.heartbeat = Heartbeat(
@@ -725,6 +819,12 @@ class Telemetry:
             span_data = {"compiles": d_count, "compile_s": d_seconds}
         elif batch is not None and self._last_shapes is None:
             self._last_shapes = tree_shapes(batch)
+        if self._anchor_seq:
+            # barrier-anchored alignment epoch: lets the pod aggregator
+            # (monitor/pod.py) fuse step N of THIS run across ranks without
+            # confusing it with step N of a previous incarnation in the same
+            # appended JSONL
+            span_data = {**(span_data or {}), "sync": self._anchor_seq}
         self.recorder.record("span", "step", step=step, dur=dur,
                              data=span_data)
         self._step_hist.observe(dur)
@@ -738,6 +838,14 @@ class Telemetry:
             self.goodput.mark_first_step()
         if self.heartbeat is not None:
             self.heartbeat.beat(step)
+        if self.cfg.textfile_enabled:
+            # heartbeat-cadence Prometheus snapshot: long multi-host runs
+            # are scraped off this file without anyone tailing JSONL
+            tnow = time.perf_counter()
+            if self._last_textfile is None or \
+                    tnow - self._last_textfile >= self.cfg.textfile_interval_s:
+                self._last_textfile = tnow
+                self.export_textfile()
         interval = self.cfg.memory_interval_steps
         if interval > 0 and step - self._last_memory_step >= interval:
             self._last_memory_step = step
@@ -780,6 +888,75 @@ class Telemetry:
                 self.goodput.account("checkpoint", dur)
             if self.heartbeat is not None:
                 self.heartbeat.beat(step, force=True)
+
+    # ----------------------------------------------------- pod-scope hooks
+    def anchor(self, tag: str = "start") -> int:
+        """Record a barrier-anchored alignment point for cross-rank trace
+        fusion (``monitor/pod.py``).
+
+        Under multiple controllers every rank calls this together (the
+        engine does, at construction — a collective contract like any
+        barrier); all ranks exit the barrier at the same true instant, so
+        the wall timestamp each rank records immediately after is the same
+        physical moment seen through that rank's clock. The pod aggregator
+        subtracts anchor timestamps to recover per-rank clock offsets —
+        including any *constant* straggling that step-boundary alignment
+        alone would silently absorb. Subsequent step spans carry the anchor
+        sequence id (``data.sync``) so steps fuse within one anchored epoch
+        only."""
+        import jax
+
+        seq = _next_anchor_seq()
+        synced = True
+        if jax.process_count() > 1:
+            try:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices(f"dstpu_pod_anchor_{seq}")
+            except Exception as e:  # pragma: no cover - backend dependent
+                # the epoch marker is still valid (step spans need it to
+                # separate epochs) but its timestamp is NOT a shared
+                # instant — flag it so the pod aggregator falls back to
+                # step-boundary alignment instead of trusting a fake offset
+                logger.warning("pod anchor barrier unavailable (%s); "
+                               "recording unsynchronized anchor", e)
+                synced = False
+        self._anchor_seq = seq
+        self.recorder.record("meta", "align/anchor",
+                             data={"anchor": seq, "tag": tag,
+                                   "synced": synced})
+        return seq
+
+    def record_census(self, census: Dict[str, Any]) -> None:
+        """Persist a static collective-census class summary
+        (``analysis/collectives.py`` ``CollectiveClasses.summary()`` shape,
+        plus any context keys) into the stream — the pod report joins it
+        against measured step spans for the per-traffic-class bytes/time/
+        bandwidth decomposition."""
+        self.recorder.record("event", "comm/census", data=census)
+
+    def export_textfile(self, path: Optional[str] = None) -> str:
+        """Write the current metrics-registry + resilience-counter state as
+        a Prometheus textfile-collector snapshot (atomic rename, scrape-safe)
+        and return the path. Called automatically at heartbeat cadence when
+        ``telemetry.textfile.enabled`` is set; safe to call manually."""
+        path = path or os.path.join(self.cfg.output_dir,
+                                    f"metrics_rank{self.rank}.prom")
+        snap = self.registry.snapshot()
+        snap = {**snap,
+                "counters": {**snap["counters"],
+                             **{f"resilience_{k}": v for k, v in
+                                resilience_counters.snapshot().items()}}}
+        text = render_prometheus(snap, labels={"rank": str(self.rank)})
+        tmp = f"{path}.tmp{os.getpid()}"
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(tmp, "w") as f:
+                f.write(text)
+            os.replace(tmp, path)
+        except OSError as e:  # export failure must never kill training
+            logger.warning("textfile export failed: %s", e)
+        return path
 
     # ------------------------------------------------------------ reporting
     def periodic_events(self, step: int) -> List[Event]:
@@ -825,6 +1002,10 @@ class Telemetry:
                 self.jsonl.flush()
             except Exception as e:
                 logger.warning("telemetry dump: jsonl flush failed: %s", e)
+        if self.cfg.textfile_enabled:
+            # the scrape file must reflect the final state too — a scraper
+            # polling a preempted run otherwise reads a stale snapshot
+            self.export_textfile()
         return records
 
     def close(self, reason: str = "shutdown") -> None:

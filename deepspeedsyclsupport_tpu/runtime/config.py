@@ -331,6 +331,11 @@ class TelemetryConfig:
     # block on the step's outputs before timing it: device-accurate step
     # spans, at the cost of the host/device dispatch overlap
     sync_timing: bool = False
+    # Prometheus textfile-collector snapshot (metrics_rank<N>.prom,
+    # atomic rename) refreshed at heartbeat cadence — long multi-host runs
+    # are scraped off this file instead of anyone tailing JSONL
+    textfile_enabled: bool = False
+    textfile_interval_s: float = 15.0
     trace_start_step: Optional[int] = None
     trace_num_steps: int = 3
     trace_dir: Optional[str] = None
@@ -339,9 +344,14 @@ class TelemetryConfig:
     def from_dict(cls, d: Dict[str, Any]) -> "TelemetryConfig":
         hb = dict(d.get("heartbeat", {}))
         tr = dict(d.get("trace", {}))
+        tf = dict(d.get("textfile", {}))
         ring = int(d.get("ring_size", 4096))
         if ring <= 0:
             raise ValueError(f"telemetry.ring_size must be > 0, got {ring}")
+        tf_interval = float(tf.get("interval_s", 15.0))
+        if tf_interval <= 0:
+            raise ValueError(f"telemetry.textfile.interval_s must be > 0, "
+                             f"got {tf_interval}")
         start = tr.get("start_step")
         return cls(
             enabled=bool(d.get("enabled", False)),
@@ -353,6 +363,8 @@ class TelemetryConfig:
             heartbeat_interval_s=float(hb.get("interval_s", 1.0)),
             stack_dump_on_hang=bool(d.get("stack_dump_on_hang", True)),
             sync_timing=bool(d.get("sync_timing", False)),
+            textfile_enabled=bool(tf.get("enabled", False)),
+            textfile_interval_s=tf_interval,
             goodput_enabled=bool(d.get("goodput", {}).get("enabled", True)
                                  if isinstance(d.get("goodput"), dict)
                                  else d.get("goodput", True)),

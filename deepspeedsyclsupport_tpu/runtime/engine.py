@@ -320,6 +320,13 @@ class Engine:
         from ..monitor.telemetry import build_telemetry
 
         self.telemetry = build_telemetry(self.config, self.monitor)
+        if self.telemetry is not None:
+            # barrier-anchored alignment point for cross-rank trace fusion
+            # (monitor/pod.py): engine construction is collective under
+            # multiple controllers, so every rank stamps the same physical
+            # instant through its own wall clock — the pod aggregator's
+            # clock-offset ground truth. Single-process: a local marker.
+            self.telemetry.anchor("engine_init")
 
         # -------------------------------------------- activation checkpointing
         # (reference runtime/activation_checkpointing/: config-driven
@@ -994,6 +1001,30 @@ class Engine:
         if log:
             comms_logger.log_summary(show_straggler=show_straggler)
         return summary
+
+    def emit_comm_census(self) -> Dict[str, Any]:
+        """Classify the compiled train step's collectives into traffic
+        classes (``analysis/collectives.py``) and persist the class summary
+        as a ``comm/census`` flight-recorder event — the static half of the
+        pod report's bytes/time/bandwidth join (``monitor/pod.py``). Also
+        records the raw per-opcode mix into ``comms_logger`` (when enabled)
+        so a ``comm/snapshot`` lands beside it on the next dump, giving the
+        offline join its measured cross-check. Returns the payload."""
+        report = self.graph_report(analyzers=("collectives",))
+        payload: Dict[str, Any] = {
+            "classes": report["collectives"].classes.summary(),
+            "group_size": report["collectives"].expectation.group_size,
+            "n_devices": int(np.prod(list(self.topology.axis_sizes.values()))),
+            "zero_stage": self.zero_stage,
+        }
+        if comms_logger.enabled:
+            # merge the measured op mix from the same compiled program into
+            # comms_logger (xla:: keys) so the next dump's comm/snapshot
+            # carries it
+            self.xla_comms_summary(log=False)
+        if self.telemetry is not None:
+            self.telemetry.record_census(payload)
+        return payload
 
     GRAPH_ANALYZERS = ("collectives", "donation", "resharding", "dtype")
 

@@ -9,11 +9,18 @@ after a preemption storm — no devices, no jax session, safe on a login node.
 Usage::
 
     python tools/trace_report.py telemetry_logs/flightrec_rank0.jsonl
-    python tools/trace_report.py logs/flightrec_rank*.jsonl --last 30
+    python tools/trace_report.py telemetry_logs/            # whole directory
+    python tools/trace_report.py 'logs/flightrec_rank*.jsonl' --last 30
+    python tools/trace_report.py telemetry_logs/ --pod      # pod-scope view
 
-With several rank files the report adds a straggler section comparing each
-host's accumulated step wall-clock (the SPMD analog of per-rank collective
-latency — a host far above the minimum is the straggler).
+Inputs may be directories (their ``flightrec*.jsonl``), glob patterns, or
+explicit files; rank ids are inferred from the ``rank<N>`` filename
+convention (or each stream's meta record). With several rank files the
+report adds a straggler section comparing each host's accumulated step
+wall-clock (the SPMD analog of per-rank collective latency — a host far
+above the minimum is the straggler). ``--pod`` switches to the full
+pod-scope report (``tools/pod_report.py``): clock-aligned per-step skew,
+straggler ledger and the per-traffic-class bandwidth decomposition.
 
 Exit code 0 on success, 2 when no input file yields any records.
 """
@@ -23,6 +30,14 @@ import os
 import sys
 from typing import Any, Dict, List, Optional
 
+# one loader for monitor/pod.py lives in pod_report (by file path, NOT
+# through the package — the package __init__ imports jax and this tool's
+# contract is "safe on a login node", stdlib only)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import pod_report  # noqa: E402
+
+_pod = pod_report.pod
+
 #: A goodput split must account for at least this fraction of wall-clock —
 #: the accounter computes ``other`` as the residual, so anything below this
 #: indicates a truncated/corrupt log rather than rounding.
@@ -30,22 +45,22 @@ ACCOUNTING_FLOOR = 0.99
 
 
 def load_records(path: str) -> List[Dict[str, Any]]:
-    records = []
+    """Parse one JSONL file with truncation salvage (``monitor/pod.py``): a
+    torn final line is EXPECTED for a crash dump — everything before it is
+    still good and is kept."""
     try:
         with open(path) as f:
-            for lineno, line in enumerate(f, 1):
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    records.append(json.loads(line))
-                except ValueError:
-                    # a torn final line is EXPECTED for a crash dump —
-                    # everything before it is still good
-                    print(f"  note: {path}:{lineno}: torn/unparsable line "
-                          f"skipped", file=sys.stderr)
+            text = f.read()
     except OSError as e:
         print(f"error: cannot read {path}: {e}", file=sys.stderr)
+        return []
+    records, bad, truncated = _pod.parse_stream_text(text)
+    if bad:
+        print(f"  note: {path}: {bad} torn/unparsable line(s) skipped",
+              file=sys.stderr)
+    elif truncated:
+        print(f"  note: {path}: no trailing newline — stream truncated "
+              f"mid-write", file=sys.stderr)
     return records
 
 
@@ -135,14 +150,14 @@ def events_summary(records: List[Dict[str, Any]]) -> List[str]:
     return lines
 
 
-def straggler_summary(per_rank: Dict[str, List[Dict[str, Any]]]) -> List[str]:
+def straggler_summary(per_rank: Dict[int, List[Dict[str, Any]]]) -> List[str]:
+    """``per_rank`` is keyed by rank id (inferred by :func:`render` from
+    filenames / stream metadata — callers no longer hand-build the dict)."""
     lines = ["stragglers (per-host accumulated step wall-clock)"]
     totals = {}
-    for path, records in per_rank.items():
+    for rank, records in per_rank.items():
         tot = sum(r.get("dur", 0.0) for r in records
                   if r.get("kind") == "span" and r.get("name") == "step")
-        meta = next((r for r in records if r.get("kind") == "meta"), {})
-        rank = (meta.get("data") or {}).get("rank", path)
         totals[f"rank{rank}"] = tot
     if not totals:
         lines.append("  (no step spans)")
@@ -156,11 +171,23 @@ def straggler_summary(per_rank: Dict[str, List[Dict[str, Any]]]) -> List[str]:
 
 
 def render(paths: List[str], last: int = 20) -> Optional[str]:
-    per_rank = {p: load_records(p) for p in paths}
-    per_rank = {p: r for p, r in per_rank.items() if r}
-    if not per_rank:
+    paths = _pod.discover_rank_files(paths)
+    per_path = {p: load_records(p) for p in paths}
+    per_path = {p: r for p, r in per_path.items() if r}
+    if not per_path:
         return None
-    first = per_rank[next(iter(per_rank))]
+    # key by inferred rank id (filename rank<N> convention, else the
+    # stream's own meta record, else position) — the straggler table wants
+    # ranks, not paths
+    per_rank: Dict[int, List[Dict[str, Any]]] = {}
+    for i, (p, records) in enumerate(per_path.items()):
+        rank = _pod.infer_rank(p, records)
+        if rank is None or rank in per_rank:
+            rank = next(n for n in range(len(per_path) + len(per_rank))
+                        if n not in per_rank)
+        per_rank[rank] = records
+    first_rank = min(per_rank)
+    first = per_rank[first_rank]
     out: List[str] = []
     n_total = sum(len(r) for r in per_rank.values())
     out.append(f"flight recorder report — {len(per_rank)} file(s), "
@@ -168,7 +195,7 @@ def render(paths: List[str], last: int = 20) -> Optional[str]:
     times = [r["t"] for r in first if "t" in r]
     if times:
         out.append(f"wall span: {max(times) - min(times):.2f}s "
-                   f"({len(first)} records in {next(iter(per_rank))})")
+                   f"({len(first)} records in rank{first_rank})")
     out.append("")
     out.extend(step_timeline(first, last))
     out.append("")
@@ -186,10 +213,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="Render a flight-recorder JSONL into a step-timeline / "
                     "goodput / straggler summary.")
     ap.add_argument("files", nargs="+",
-                    help="flight-recorder JSONL file(s), one per rank")
+                    help="flight-recorder JSONL file(s), glob pattern(s) or "
+                         "directories — one stream per rank")
     ap.add_argument("--last", type=int, default=20,
                     help="how many trailing steps to show in the timeline")
+    ap.add_argument("--pod", action="store_true",
+                    help="pod-scope report instead (alias for "
+                         "tools/pod_report.py: clock-aligned skew, straggler "
+                         "ledger, per-class bandwidth decomposition)")
     args = ap.parse_args(argv)
+    if args.pod:
+        return pod_report.main([*args.files, "--last", str(args.last)])
     report = render([os.path.expanduser(p) for p in args.files],
                     last=args.last)
     if report is None:
