@@ -65,8 +65,12 @@ class CheckpointEngine(ABC):
 def _write_latest(latest_file: Optional[str], tag: str) -> None:
     """Atomically repoint ``latest``: temp file + fsync + ``os.replace``.
     An in-place ``write()`` can be torn by a crash, leaving a pointer that
-    names no tag — after which every restart fails to resume."""
-    if latest_file and jax.process_index() == 0:
+    names no tag — after which every restart fails to resume. Pod rank 0
+    only (env-declared pods included — two replicas repointing the same
+    file would race)."""
+    from ..utils.podid import pod_rank
+
+    if latest_file and pod_rank() == 0:
         from .engine import _durable_write
 
         _durable_write(latest_file + ".tmp", tag,
@@ -92,18 +96,46 @@ def sweep_staging_dirs(directory: str, keep: Optional[str] = None,
     checkpoint when the old tag dir was already deleted to make way for it.
     Everything else is removed. Returns the number handled.
 
+    Torn-POD tags are also quarantined here: a preemption that landed
+    between the commit protocol's phases (rank manifests written, no pod
+    commit record — see ``checkpoint/engine.py::pod_commit``) leaves a tag
+    no rank must ever resolve. The sweep runs at resume time, when no save
+    can be in flight, so a commit-less tag here is conclusively torn rather
+    than merely in progress.
+
     ``deep=False`` verifies by structure + size only (no crc re-read) — for
     callers on the training thread, where re-streaming a multi-GB orphan
     would stall the step; same-size bit rot in a promoted tag is still
     caught at load time and quarantined."""
-    from .engine import quarantine_tag, verify_tree
+    from .engine import (_QUARANTINE_RE, is_torn_pod, quarantine_tag,
+                         verify_tree)
 
     handled = 0
     promoted = 0
+    quarantined = 0
     try:
         names = os.listdir(directory)
     except OSError:
         return 0
+    for name in names:
+        p = os.path.join(directory, name)
+        if name.startswith(".staging") or _QUARANTINE_RE.search(name) \
+                or not os.path.isdir(p) or p == keep:
+            continue
+        if is_torn_pod(p):
+            try:
+                dst = quarantine_tag(p)
+            except OSError as e:  # leave it; the verify gate still skips it
+                logger.warning("could not quarantine torn-pod tag %s: %s",
+                               p, e)
+                continue
+            logger.warning("quarantined torn-pod checkpoint %s -> %s (rank "
+                           "manifests without a matching pod commit)", p, dst)
+            quarantined += 1
+    if quarantined:
+        from ..monitor.monitor import resilience_counters
+
+        resilience_counters.incr("torn_pod_quarantined", quarantined)
     for name in names:
         p = os.path.join(directory, name)
         if not (name.startswith(".staging") and os.path.isdir(p)
@@ -144,7 +176,7 @@ def sweep_staging_dirs(directory: str, keep: Optional[str] = None,
         resilience_counters.incr("staging_sweeps", handled - promoted)
         if promoted:
             resilience_counters.incr("staging_promotions", promoted)
-    return handled
+    return handled + quarantined
 
 
 class NativeCheckpointEngine(CheckpointEngine):
@@ -182,11 +214,16 @@ class AsyncCheckpointEngine(CheckpointEngine):
              post_commit=None):
         from .engine import save_tree
 
-        if jax.process_count() > 1:
-            # multi-controller writes are collective (orbax) — degrade to
-            # sync rather than running collectives off-thread
+        from ..utils.podid import pod_world
+
+        if jax.process_count() > 1 or pod_world() > 1:
+            # multi-controller writes are collective (orbax), and an
+            # env-declared pod's commit protocol barriers on sibling
+            # manifests in the FINAL tag dir — neither belongs on a
+            # background thread staging under a colliding path: degrade to
+            # sync
             logger.warning("async checkpoint engine degrades to synchronous "
-                           "saves under multi-controller execution")
+                           "saves under multi-rank execution")
             save_tree(path, state, meta)
             _write_latest(latest_file, tag)
             _run_post_commit(post_commit)

@@ -37,6 +37,20 @@ DATA_FILE = "state.bin"
 STATE_DIR = "state"  # orbax subdir
 LATEST_FILE = "latest"  # tag-pointer file (kept in sync with runtime/engine.py)
 INTEGRITY_KEY = "__integrity__"  # manifest section inside META_FILE
+# Two-phase pod commit (all-ranks checkpoint consistency): phase 1 = every
+# rank durably writes its own rank manifest after its shard payload; phase 2
+# = rank 0 writes the pod commit record (expected rank set + per-rank
+# manifest digests) only after a cross-process barrier proved every rank's
+# phase 1 done. A tag with rank manifests but no (or mismatched) commit
+# record is a TORN POD — a preemption landed between the phases — and is
+# never resolved by any rank (quarantined by the resume-time staging sweep).
+COMMIT_FILE = "dstpu_commit.json"
+_RANK_MANIFEST_RE = re.compile(r"^dstpu_rank_(\d+)\.json$")
+
+
+def rank_manifest_name(rank: int) -> str:
+    """Phase-1 per-rank manifest filename inside a tag directory."""
+    return f"dstpu_rank_{int(rank)}.json"
 
 
 class CheckpointCorruptionError(RuntimeError):
@@ -92,18 +106,27 @@ def save_tree(path: str, state: Dict[str, Any], meta: Dict[str, Any]) -> None:
     crc32, per-leaf crc32 in the index) that :func:`verify_tree` and
     :func:`load_tree` check so a torn or bit-rotted checkpoint is detected
     at load time instead of poisoning a resumed run."""
+    from ..utils.podid import pod_identity
+
     path = os.path.abspath(path)
     os.makedirs(path, exist_ok=True)
+    rank, _world = pod_identity()
     if jax.process_count() > 1:  # pragma: no cover - needs real pod
         _save_orbax(path, state)
-    else:
+    elif rank == 0:
+        # env-declared pod of single-controller replicas (utils/podid.py):
+        # every member holds the same full state, so rank 0 owns the
+        # payload and the siblings only participate in the commit protocol
+        # below — two replicas streaming the same bytes into one file
+        # would race each other
         _save_native(path, state)
-    if jax.process_index() == 0:
+    if rank == 0:
         meta = dict(meta)
         meta[INTEGRITY_KEY] = _build_manifest(path)
         meta_path = os.path.join(path, META_FILE)
         _durable_write(meta_path, json.dumps(_jsonable(meta), indent=2),
                        what=f"checkpoint meta write {meta_path}")
+    pod_commit(path, meta)
     # torn-write simulation happens after the save claims durability: the
     # failure mode under test is "save completed, file is still short"
     fi = get_fault_injector()
@@ -111,6 +134,7 @@ def save_tree(path: str, state: Dict[str, Any], meta: Dict[str, Any]) -> None:
         p = os.path.join(path, fname)
         if os.path.exists(p):
             fi.maybe_truncate(p)
+    fi.maybe_tear_pod(path, rank)
 
 
 def _durable_write(path: str, text: str, what: str,
@@ -153,6 +177,173 @@ def _build_manifest(path: str) -> Dict[str, Any]:
         if os.path.exists(p):
             files[fname] = _file_digest(p)
     return {"version": 1, "files": files}
+
+
+# ------------------------------------------------------------ pod commit
+#: rank 0 waits at most this long (env-overridable) for sibling phase-1
+#: manifests before leaving the tag uncommitted (= torn, never resolved)
+POD_COMMIT_TIMEOUT_ENV = "DSTPU_POD_COMMIT_TIMEOUT_S"
+_POD_COMMIT_TIMEOUT_S = 120.0
+_POD_COMMIT_POLL_S = 0.05
+
+
+def pod_commit(path: str, meta: Dict[str, Any],
+               timeout_s: Optional[float] = None) -> bool:
+    """Two-phase all-ranks commit of one tag directory.
+
+    Phase 1 (every rank): atomically publish this rank's manifest — the
+    durable record that *this rank* finished its part of the save at this
+    ``global_steps``. Phase 2 (rank 0 only): once every expected rank's
+    manifest is present — via the ``jax.distributed`` barrier when one
+    exists, else by polling the shared directory (the env-declared pod of
+    independent controllers, ``utils/podid.py``) — write the pod commit
+    record naming the expected rank set and each rank's manifest digest.
+
+    A death between the phases leaves rank manifests without a commit
+    record — a torn pod, detected by :func:`pod_complete`, skipped by
+    every resolution walk and quarantined by the resume-time sweep. If the
+    siblings never show up within the timeout, rank 0 *leaves the tag
+    uncommitted* (the correct verdict: the pod did not complete this save)
+    rather than failing the caller. Single-process saves run the identical
+    protocol with ``world_size=1`` so the format stays uniform. Returns
+    whether this rank considers the tag committed."""
+    import time
+
+    from ..utils.podid import pod_identity
+
+    t0 = time.perf_counter()
+    rank, world = pod_identity()
+    rm = {"version": 1, "rank": int(rank), "world_size": int(world),
+          "global_steps": meta.get("global_steps")}
+    rm_path = os.path.join(path, rank_manifest_name(rank))
+    # atomic publish (tmp + rename): a polling rank 0 must never read a
+    # half-written sibling manifest as evidence
+    _durable_write(rm_path + f".tmp{os.getpid()}",
+                   json.dumps(_jsonable(rm), sort_keys=True),
+                   what=f"rank manifest write {rm_path}",
+                   rename_to=rm_path)
+    committed = True
+    if world > 1 and jax.process_count() > 1:  # pragma: no cover - real pod
+        from jax.experimental import multihost_utils
+
+        # the barrier IS the phase-1→2 hand-off: no rank passes it before
+        # its manifest is durable, so rank 0 reads complete evidence below
+        multihost_utils.sync_global_devices(
+            f"dstpu_pod_commit_{os.path.basename(path)}_"
+            f"{meta.get('global_steps')}")
+    if rank == 0:
+        committed = _commit_as_rank0(path, meta, world, timeout_s)
+    from ..monitor.telemetry import metrics_registry
+
+    metrics_registry.histogram("ckpt_pod_commit_s").observe(
+        time.perf_counter() - t0)
+    return committed
+
+
+def _commit_as_rank0(path: str, meta: Dict[str, Any], world: int,
+                     timeout_s: Optional[float]) -> bool:
+    """Rank 0's phase 2: gather every rank's manifest (polling covers pods
+    with no collective backend), cross-check the step, write the commit."""
+    import time
+
+    if timeout_s is None:
+        try:
+            timeout_s = float(os.environ.get(POD_COMMIT_TIMEOUT_ENV,
+                                             _POD_COMMIT_TIMEOUT_S))
+        except ValueError:
+            timeout_s = _POD_COMMIT_TIMEOUT_S
+    want_steps = meta.get("global_steps")
+    deadline = time.monotonic() + timeout_s
+    digests: Dict[int, int] = {}
+    while True:
+        for r in range(world):
+            if r in digests:
+                continue
+            p = os.path.join(path, rank_manifest_name(r))
+            try:
+                with open(p, "rb") as f:
+                    raw = f.read()
+                rm = json.loads(raw.decode())
+            except (OSError, ValueError):
+                continue  # not there yet (publish is atomic, so no partials)
+            if want_steps is not None and \
+                    rm.get("global_steps") != want_steps:
+                continue  # a stale manifest from an older save of this tag
+            digests[r] = zlib.crc32(raw)
+        if len(digests) == world:
+            break
+        if time.monotonic() >= deadline:
+            logger.error(
+                "pod commit of %s: only %d/%d rank manifest(s) appeared "
+                "within %.0fs — leaving the tag UNCOMMITTED (torn pod; no "
+                "rank will resolve it)", path, len(digests), world,
+                timeout_s)
+            return False
+        time.sleep(_POD_COMMIT_POLL_S)
+    commit = {"version": 1, "world_size": int(world),
+              "global_steps": want_steps,
+              "ranks": {str(r): d for r, d in sorted(digests.items())}}
+    commit_path = os.path.join(path, COMMIT_FILE)
+    _durable_write(commit_path + f".tmp{os.getpid()}",
+                   json.dumps(_jsonable(commit), indent=2, sort_keys=True),
+                   what=f"pod commit write {commit_path}",
+                   rename_to=commit_path)
+    _counters().incr("pod_commits")
+    return True
+
+
+def pod_complete(path: str) -> Tuple[bool, str]:
+    """Is this tag a *pod-complete* checkpoint — every rank of the saving
+    pod committed? Returns ``(ok, reason)``. A tag with no commit record
+    and no rank manifests predates the protocol and is treated as complete
+    (its per-file integrity manifest still gates it); a tag with phase-1
+    manifests but a missing/mismatched commit record is a torn pod."""
+    try:
+        names = os.listdir(path)
+    except OSError as e:
+        return False, f"unreadable tag dir: {e}"
+    manifests = {int(m.group(1)): n for n in names
+                 for m in [_RANK_MANIFEST_RE.match(n)] if m}
+    commit_path = os.path.join(path, COMMIT_FILE)
+    if not os.path.exists(commit_path):
+        if manifests:
+            return False, (f"torn pod: {len(manifests)} rank manifest(s) "
+                           f"but no {COMMIT_FILE} (commit phase never ran)")
+        return True, "ok (pre-pod-commit tag)"
+    try:
+        with open(commit_path) as f:
+            commit = json.load(f)
+        ranks = {int(r): int(d) for r, d in commit["ranks"].items()}
+        world = int(commit["world_size"])
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        return False, f"unreadable {COMMIT_FILE}: {e!r}"
+    if sorted(ranks) != list(range(world)):
+        return False, (f"torn pod: commit names ranks {sorted(ranks)} but "
+                       f"world_size is {world}")
+    for r, want in sorted(ranks.items()):
+        p = os.path.join(path, rank_manifest_name(r))
+        try:
+            with open(p, "rb") as f:
+                got = zlib.crc32(f.read())
+        except OSError:
+            return False, f"torn pod: rank {r} manifest missing"
+        if got != want:
+            return False, (f"torn pod: rank {r} manifest digest {got} != "
+                           f"committed {want}")
+    return True, "ok"
+
+
+def is_torn_pod(path: str) -> bool:
+    """True when the tag carries pod-commit protocol files that do NOT add
+    up to a complete pod — the quarantine predicate for the resume-time
+    sweep (a protocol-less legacy tag is not torn, just old)."""
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return False
+    has_protocol = (COMMIT_FILE in names
+                    or any(_RANK_MANIFEST_RE.match(n) for n in names))
+    return has_protocol and not pod_complete(path)[0]
 
 
 def load_tree(path: str, template: Dict[str, Tuple[Any, Any]]
@@ -343,6 +534,12 @@ def verify_tree(path: str, deep: bool = True) -> Tuple[bool, str]:
             meta = json.load(f)
     except (ValueError, OSError) as e:
         return False, f"unreadable {META_FILE}: {e}"
+    # pod completeness gates BOTH layouts (native and orbax): a tag only a
+    # subset of ranks committed must never verify, or a restarted pod would
+    # resume half-written state — see pod_commit/pod_complete
+    ok_pod, pod_reason = pod_complete(path)
+    if not ok_pod:
+        return False, pod_reason
     index_path = os.path.join(path, INDEX_FILE)
     if not os.path.exists(index_path):
         # orbax layout: content integrity is orbax's own (per-array
@@ -432,7 +629,13 @@ def list_tags(load_dir: str) -> List[str]:
                 steps = int(json.load(f).get("global_steps", -1))
         except (OSError, ValueError, TypeError):
             pass  # torn meta: still a candidate, ranked by mtime only
-        out.append((steps, os.path.getmtime(p), name))
+        try:
+            mtime = os.path.getmtime(p)
+        except OSError:
+            # renamed/deleted out from under the walk — a sibling rank's
+            # sweep quarantining a torn-pod tag races this listing
+            continue
+        out.append((steps, mtime, name))
     out.sort(reverse=True)
     return [name for _, _, name in out]
 

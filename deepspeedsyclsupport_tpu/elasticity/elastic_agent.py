@@ -24,6 +24,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from .elasticity import ElasticityError, compute_elastic_config
+from ..comm.watchdog import COMM_HANG_EXIT_CODE
 from ..runtime.resilience import PREEMPTION_EXIT_CODE
 from ..utils.logging import logger
 
@@ -31,16 +32,32 @@ from ..utils.logging import logger
 class DSElasticAgent:
     """Supervise an elastic training command (reference ``DSElasticAgent``).
 
-    Restart accounting distinguishes two exit classes:
+    Restart accounting distinguishes three exit classes:
 
-    * ``PREEMPTION_EXIT_CODE`` — the worker caught SIGTERM, wrote an emergency
-      checkpoint and exited cleanly. The restart is *free* (a preempted VM is
-      fleet weather, not a crash loop) and relaunch is immediate.
+    * ``PREEMPTION_EXIT_CODE`` (217) — the worker caught SIGTERM, wrote an
+      emergency checkpoint and exited cleanly. The restart is *free* (a
+      preempted VM is fleet weather, not a crash loop) and relaunch is
+      paced at the base backoff.
+    * ``COMM_HANG_EXIT_CODE`` (218) — the collective watchdog
+      (``comm/watchdog.py``) declared a hung all-reduce and aborted with
+      stacks + flight recorder on disk. Counted separately
+      (``comm_hang_restarts``, bounded by ``comm_hang_limit``) and backed
+      off exponentially — a broken link would hot-loop — but never billed
+      against ``restart_limit``: the code didn't crash, the fabric (or one
+      host) did.
     * any other non-zero rc — a real failure: counted against
       ``restart_limit`` and backed off exponentially
       (``backoff_seconds * 2^failures`` + jitter, capped at
       ``backoff_ceiling``) so a hard crash loop cannot hammer the cluster
       scheduler or a shared filesystem.
+
+    With ``nprocs`` set the agent supervises a local POD: it spawns one
+    process per rank (``RANK``/``LOCAL_RANK`` exported) and, the moment any
+    rank exits non-zero, terminates the siblings immediately — they are
+    wedged in a collective their dead peer will never join, and waiting for
+    them to cascade into their own timeouts wastes the whole recovery
+    budget. ``storm_limit`` caps TOTAL relaunches of any cause so no
+    combination of free-restart classes can loop forever.
     """
 
     def __init__(self, cmd: Sequence[str], ds_config: Dict[str, Any],
@@ -51,6 +68,10 @@ class DSElasticAgent:
                  backoff_jitter: float = 0.25,
                  backoff_seed: Optional[int] = None,
                  preemption_limit: Optional[int] = None,
+                 comm_hang_limit: Optional[int] = None,
+                 storm_limit: Optional[int] = None,
+                 nprocs: Optional[int] = None,
+                 teardown_grace: float = 5.0,
                  env: Optional[Dict[str, str]] = None,
                  hostfile: Optional[str] = None,
                  sleep_fn: Optional[Callable[[float], None]] = None,
@@ -70,6 +91,18 @@ class DSElasticAgent:
         # preemption rc (None = unbounded): a fleet-wide drain that SIGTERMs
         # every relaunch would otherwise loop forever
         self.preemption_limit = preemption_limit
+        # consecutive watchdog comm-hang exits (rc 218) before giving up —
+        # a persistently broken interconnect is not self-healing
+        self.comm_hang_limit = comm_hang_limit
+        # restart-storm cap: TOTAL relaunches of ANY cause (failure,
+        # preemption, comm hang). The per-class limits each bound their own
+        # streak; this bounds their sum, so alternating causes can't dodge
+        # every limit (None = unbounded).
+        self.storm_limit = storm_limit
+        # pod supervision: spawn nprocs rank processes per launch and tear
+        # the survivors down promptly when any rank dies
+        self.nprocs = nprocs
+        self.teardown_grace = teardown_grace
         # seedable jitter so the fault-injection suite replays identically
         self._rng = random.Random(backoff_seed)
         self._sleep = sleep_fn or time.sleep
@@ -88,6 +121,8 @@ class DSElasticAgent:
         self.hang_count = 0
         self.restart_count = 0  # failures only — preemptions are free
         self.preemption_count = 0
+        self.comm_hang_count = 0
+        self.teardown_count = 0
         self.launch_history: List[Dict[str, Any]] = []
 
     def next_backoff(self, consecutive_failures: int) -> float:
@@ -161,6 +196,8 @@ class DSElasticAgent:
         staleness: SIGUSR1 (worker faulthandler dumps all stacks) → grace →
         SIGTERM → SIGKILL. A hang-killed worker returns a negative rc and is
         counted as a failure by :meth:`run`."""
+        if self.nprocs is not None:
+            return self._launch_pod(env)
         if self.heartbeat_file is None or self.heartbeat_timeout is None:
             return subprocess.run(self.cmd, env=env).returncode
         import signal
@@ -203,6 +240,151 @@ class DSElasticAgent:
                 proc.kill()
         return proc.wait()
 
+    # ------------------------------------------------------------- pod mode
+    def _launch_pod(self, env: Dict[str, str]) -> int:
+        """Spawn ``nprocs`` rank processes and supervise them as ONE pod.
+
+        The moment any rank self-exits non-zero the siblings are terminated
+        immediately (SIGTERM → ``teardown_grace`` → SIGKILL): under SPMD
+        they are wedged inside a collective their dead peer will never
+        join, and letting each discover that through its own timeout
+        multiplies the recovery latency by the world size. The pod rc is
+        the most *specific* self-exit cause observed — rc 218 (comm hang)
+        over rc 217 (preemption) over the first plain failure — so the
+        restart accounting in :meth:`run` classifies the pod by its root
+        cause, not by whichever sibling our SIGTERM reaped first."""
+        import signal
+
+        for path in self._heartbeat_files():
+            try:  # a leftover beat from the last incarnation is stale
+                os.unlink(path)
+            except OSError:
+                pass
+        procs: List[subprocess.Popen] = []
+        for r in range(self.nprocs):
+            penv = dict(env)
+            penv["RANK"] = str(r)
+            penv.setdefault("LOCAL_RANK", str(r))
+            # declare the pod to the workers (utils/podid.py): the
+            # checkpoint commit protocol and telemetry rank labels need
+            # identity even when jax.distributed isn't in play. Force-set
+            # like RANK above — a stale DSTPU_POD_RANKS inherited from the
+            # shell would make rank 0 wait for manifests from ranks this
+            # pod doesn't have, leaving every save torn.
+            penv["DSTPU_POD_RANKS"] = str(self.nprocs)
+            procs.append(subprocess.Popen(self.cmd, env=penv))
+        launched_at = time.monotonic()
+        rcs: Dict[int, Optional[int]] = {}
+        killed: set = set()
+        tore_down = False
+        while len(rcs) < len(procs):
+            for i, p in enumerate(procs):
+                if i not in rcs:
+                    rc = p.poll()
+                    if rc is not None:
+                        rcs[i] = rc
+            # a clean preemption (rc 217) does NOT trigger teardown: the
+            # scheduler SIGTERMed every rank, and the siblings are busy
+            # writing their own emergency checkpoints — killing them after
+            # teardown_grace would tear exactly the saves the rc-217
+            # free-restart contract exists to preserve. They exit 217 on
+            # their own; crashes and watchdog aborts (218) tear down NOW.
+            self_failed = {i: rc for i, rc in rcs.items()
+                           if rc not in (0, PREEMPTION_EXIT_CODE)
+                           and i not in killed}
+            if self_failed and not tore_down and len(rcs) < len(procs):
+                tore_down = True
+                self._teardown_siblings(procs, rcs, killed, self_failed)
+                continue  # collect the terminated siblings' rcs
+            if len(rcs) == len(procs):
+                break
+            if self.heartbeat_file is not None \
+                    and self.heartbeat_timeout is not None \
+                    and self._heartbeat_stale(launched_at):
+                from ..monitor.monitor import resilience_counters
+
+                self.hang_count += 1
+                resilience_counters.incr("hang_restarts")
+                logger.error("elastic agent: pod heartbeat stale > %.1fs — "
+                             "stack-dumping and killing all ranks",
+                             self.heartbeat_timeout)
+                if hasattr(signal, "SIGUSR1"):
+                    for i, p in enumerate(procs):
+                        if i not in rcs:
+                            try:
+                                p.send_signal(signal.SIGUSR1)
+                            except OSError:
+                                pass
+                    self._sleep(self.hang_grace)
+                for i, p in enumerate(procs):
+                    if i not in rcs:
+                        killed.add(i)
+                        try:
+                            p.terminate()
+                        except OSError:  # pragma: no cover
+                            pass
+                self._kill_procs(procs, rcs)
+                break
+            self._sleep(self.heartbeat_poll)
+        for i, p in enumerate(procs):
+            if i not in rcs:
+                rcs[i] = p.wait()
+        self_exits = {i: rc for i, rc in rcs.items()
+                      if i not in killed and rc is not None}
+        return self._pod_rc(rcs, self_exits)
+
+    def _teardown_siblings(self, procs, rcs, killed, self_failed) -> None:
+        """Prompt pod teardown: a rank died, so end the survivors NOW."""
+        from ..monitor.monitor import resilience_counters
+
+        self.teardown_count += 1
+        resilience_counters.incr("pod_teardowns")
+        logger.error("elastic agent: rank(s) %s exited %s — tearing down "
+                     "%d sibling rank(s) immediately (no cascade wait)",
+                     sorted(self_failed), sorted(self_failed.values()),
+                     sum(1 for i in range(len(procs)) if i not in rcs))
+        for i, p in enumerate(procs):
+            if i in rcs:
+                continue
+            rc = p.poll()
+            if rc is not None:
+                # it self-exited in the window since the last poll round:
+                # record the real rc instead of writing it off as our kill
+                # (a sibling's own rc 218 must keep its cause attribution)
+                rcs[i] = rc
+                continue
+            killed.add(i)
+            try:
+                p.terminate()
+            except OSError:  # pragma: no cover - died under us
+                pass
+        self._kill_procs(procs, rcs)
+
+    def _kill_procs(self, procs, rcs) -> None:
+        """Grace-bounded reap: SIGTERM was sent; escalate to SIGKILL."""
+        deadline = time.monotonic() + self.teardown_grace
+        for i, p in enumerate(procs):
+            if i in rcs:
+                continue
+            timeout = max(0.1, deadline - time.monotonic())
+            try:
+                p.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    def _pod_rc(self, rcs: Dict[int, int], self_exits: Dict[int, int]) -> int:
+        """Aggregate a pod's exit: most specific self-exit cause wins."""
+        fails = {i: rc for i, rc in self_exits.items() if rc != 0}
+        if not fails:
+            # every rank either succeeded or only died by our hand
+            # (heartbeat-hang kills land here: negative rc, counted by run)
+            non_zero = [rc for rc in rcs.values() if rc != 0]
+            return 0 if not non_zero else non_zero[0]
+        for cause in (COMM_HANG_EXIT_CODE, PREEMPTION_EXIT_CODE):
+            if cause in fails.values():
+                return cause
+        return fails[min(fails)]
+
     # ------------------------------------------------------------------ run
     def run(self) -> int:
         """Launch; restart on failure up to ``restart_limit`` times. A
@@ -215,6 +397,7 @@ class DSElasticAgent:
 
         consecutive_failures = 0
         consecutive_preemptions = 0
+        consecutive_comm_hangs = 0
         while True:
             world = self.discover_world_size()
             if world < self.min_nodes:
@@ -222,22 +405,64 @@ class DSElasticAgent:
                     f"deployment of {world} below min_nodes {self.min_nodes}")
             if 0 < self.max_nodes < world:
                 world = self.max_nodes
+            attempt = (self.restart_count + self.preemption_count
+                       + self.comm_hang_count)
             env = dict(os.environ)
             env.update(self.extra_env)
             env.update(self._resolve(world))
             env["DSTPU_ELASTIC_RESTART_COUNT"] = str(self.restart_count)
             env["DSTPU_ELASTIC_PREEMPTION_COUNT"] = str(self.preemption_count)
+            env["DSTPU_ELASTIC_COMM_HANG_COUNT"] = str(self.comm_hang_count)
+            # total prior relaunches of any cause: workers use it to rotate
+            # rendezvous ports / name per-incarnation artifacts
+            env["DSTPU_ELASTIC_ATTEMPT"] = str(attempt)
             env["DSTPU_ELASTIC_WORLD_SIZE"] = str(world)
             logger.info("elastic agent: launching (attempt %d, world=%d)",
-                        self.restart_count + self.preemption_count + 1, world)
+                        attempt + 1, world)
             rc = self._launch(env)
             self.launch_history.append(
                 {"world": world, "rc": rc,
                  "restart": self.restart_count,
-                 "preempted": rc == PREEMPTION_EXIT_CODE})
+                 "preempted": rc == PREEMPTION_EXIT_CODE,
+                 "comm_hang": rc == COMM_HANG_EXIT_CODE})
             if rc == 0:
                 return 0
             resilience_counters.incr("restarts")
+            if self.storm_limit is not None and \
+                    (self.restart_count + self.preemption_count
+                     + self.comm_hang_count) >= self.storm_limit:
+                logger.error("elastic agent: restart storm — %d total "
+                             "relaunches reached storm_limit %d (last "
+                             "rc=%d); giving up",
+                             self.restart_count + self.preemption_count
+                             + self.comm_hang_count, self.storm_limit, rc)
+                return rc
+            if rc == COMM_HANG_EXIT_CODE:
+                # the collective watchdog aborted a hung all-reduce: stacks
+                # and flight recorder are on disk, the checkpoint is whatever
+                # the last pod-complete tag says. Not billed against
+                # restart_limit (the code didn't crash), but backed off
+                # exponentially — a severed link would otherwise hot-loop —
+                # and bounded by its own consecutive limit.
+                self.comm_hang_count += 1
+                consecutive_comm_hangs += 1
+                consecutive_failures = 0
+                consecutive_preemptions = 0
+                resilience_counters.incr("comm_hang_restarts")
+                if self.comm_hang_limit is not None \
+                        and consecutive_comm_hangs > self.comm_hang_limit:
+                    logger.error("elastic agent: %d consecutive comm hangs "
+                                 "exceeds limit %d — giving up",
+                                 consecutive_comm_hangs, self.comm_hang_limit)
+                    return rc
+                delay = self.next_backoff(consecutive_comm_hangs)
+                logger.warning("elastic agent: pod comm hang (rc=%d, hang "
+                               "#%d) — restarting from the newest "
+                               "pod-complete checkpoint in %.2fs",
+                               rc, self.comm_hang_count, delay)
+                if delay > 0:
+                    self._sleep(delay)
+                continue
             if rc == PREEMPTION_EXIT_CODE:
                 # clean preemption: durable emergency checkpoint exists, the
                 # eviction wasn't the worker's fault — the restart is free,
@@ -247,6 +472,7 @@ class DSElasticAgent:
                 self.preemption_count += 1
                 consecutive_preemptions += 1
                 consecutive_failures = 0
+                consecutive_comm_hangs = 0
                 if self.preemption_limit is not None \
                         and consecutive_preemptions > self.preemption_limit:
                     logger.error("elastic agent: %d consecutive preemptions "
@@ -265,6 +491,7 @@ class DSElasticAgent:
             self.restart_count += 1
             consecutive_failures += 1
             consecutive_preemptions = 0
+            consecutive_comm_hangs = 0
             if self.restart_count > self.restart_limit:
                 logger.error("elastic agent: restart limit %d exhausted "
                              "(last rc=%d)", self.restart_limit,
@@ -296,6 +523,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--preemption-limit", type=int, default=None,
                     help="consecutive preemption exits before the agent "
                          "gives up (default: unbounded)")
+    ap.add_argument("--comm-hang-limit", type=int, default=None,
+                    help="consecutive collective-watchdog exits (rc 218) "
+                         "before the agent gives up (default: unbounded)")
+    ap.add_argument("--storm-limit", type=int, default=None,
+                    help="TOTAL relaunches of any cause before the agent "
+                         "gives up — the restart-storm cap (default: "
+                         "unbounded)")
+    ap.add_argument("--nprocs", type=int, default=None,
+                    help="supervise a local pod of N rank processes "
+                         "(RANK/LOCAL_RANK exported per rank); when any "
+                         "rank dies its siblings are torn down immediately")
+    ap.add_argument("--teardown-grace", type=float, default=5.0,
+                    help="seconds between SIGTERM and SIGKILL during a pod "
+                         "teardown")
     ap.add_argument("--heartbeat-file", default=None,
                     help="telemetry heartbeat file to watch (the worker's "
                          "telemetry_logs/heartbeat_rank0.json); a glob like "
@@ -318,6 +559,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                            backoff_seconds=args.backoff_seconds,
                            backoff_ceiling=args.backoff_ceiling,
                            preemption_limit=args.preemption_limit,
+                           comm_hang_limit=args.comm_hang_limit,
+                           storm_limit=args.storm_limit,
+                           nprocs=args.nprocs,
+                           teardown_grace=args.teardown_grace,
                            heartbeat_file=args.heartbeat_file,
                            heartbeat_timeout=args.heartbeat_timeout,
                            hostfile=args.hostfile)

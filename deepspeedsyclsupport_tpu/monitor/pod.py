@@ -115,7 +115,7 @@ POD_SCHEMA_VERSION = 1
 #: validates emitted reports against this)
 POD_REPORT_KEYS = ("schema_version", "ranks", "truncated_ranks",
                    "missing_ranks", "n_steps", "align", "steps", "skew",
-                   "straggler", "decomposition", "census")
+                   "straggler", "decomposition", "census", "comm_hang")
 
 #: ``flightrec_rank3.jsonl`` / ``whatever-rank12.jsonl`` → rank id
 _RANK_FILE_RE = re.compile(r"rank(\d+)[^0-9]*\.jsonl$")
@@ -314,6 +314,107 @@ def _anchors(records: Sequence[Dict[str, Any]]) -> Dict[int, float]:
     return out
 
 
+def _comm_marks(records: Sequence[Dict[str, Any]]
+                ) -> Tuple[Dict[int, float], List[Dict[str, Any]]]:
+    """Watchdog marks from the newest incarnation: ``comm/arm`` events
+    (step → wall time the rank arrived at its collective dispatch) and any
+    ``comm/hang`` abort events (``comm/watchdog.py``). The arm is the
+    pre-dispatch deadline stamp; the per-step ``step`` span is its post
+    record — so an arm with no matching span is a step that never came
+    back."""
+    arms: Dict[int, float] = {}
+    hangs: List[Dict[str, Any]] = []
+    for rec in _newest_incarnation(records):
+        if rec.get("kind") != "event":
+            continue
+        if rec.get("name") == "comm/arm" and "step" in rec:
+            arms[int(rec["step"])] = float(rec.get("t", 0.0))
+        elif rec.get("name") == "comm/hang":
+            h = dict(rec.get("data") or {})
+            if rec.get("step") is not None:
+                h.setdefault("step", int(rec["step"]))
+            hangs.append(h)
+    return arms, hangs
+
+
+def attribute_comm_hang(streams: Dict[int, "RankStream"], align: "Alignment",
+                        spans: Dict[int, Dict]) -> Optional[Dict[str, Any]]:
+    """Name the rank that hung the pod.
+
+    Joins each rank's pre-dispatch ``comm/arm`` stamps against its
+    completed step spans: on the fatal step, ranks that ARMED but never
+    completed were *waiting inside the collective*; a rank that armed
+    earlier steps but never armed the fatal one **never arrived** — it is
+    the culprit the whole pod was waiting for. When every rank armed (the
+    hang was inside the fabric, not before it), the last rank to arm is
+    the suspect — the fatal-step extension of the last-arriving-rank
+    straggler ledger. Returns ``None`` when no stream shows a watchdog
+    abort or a dangling arm."""
+    marks = {r: _comm_marks(s.records) for r, s in streams.items()}
+    watchdog_ranks = sorted(r for r, (a, h) in marks.items() if a or h)
+    if not watchdog_ranks:
+        return None
+    hang_events = [h for r in watchdog_ranks for h in marks[r][1]]
+    done = {r: {s for (_sync, s) in spans.get(r, {})} for r in streams}
+    hang_steps = [int(h["step"]) for h in hang_events
+                  if h.get("step") is not None]
+    if hang_steps:
+        step = max(hang_steps)
+    else:
+        # no (step-carrying) abort record — a salvaged/torn stream may
+        # hold a comm/hang without its step field; fall back to the
+        # newest arm that never came back
+        dangling = [s for r in watchdog_ranks
+                    for s in marks[r][0] if s not in done[r]]
+        if not dangling:
+            return None if not hang_events else {
+                "step": None, "arrived_ranks": [], "never_arrived_ranks": [],
+                "stuck_ranks": [],
+                "detected_by_ranks": sorted(
+                    {int(h["rank"]) for h in hang_events
+                     if h.get("rank") is not None}),
+                "deadline_s": None, "waited_s": None}
+        step = max(dangling)
+    arrived = sorted(r for r in watchdog_ranks if step in marks[r][0])
+    never = sorted(r for r in watchdog_ranks if step not in marks[r][0])
+    stuck = sorted(r for r in arrived if step not in done[r])
+    detected_by = sorted({int(h["rank"]) for h in hang_events
+                          if h.get("rank") is not None}
+                         or {r for r in watchdog_ranks if marks[r][1]})
+    out: Dict[str, Any] = {
+        "step": step,
+        "arrived_ranks": arrived,
+        "never_arrived_ranks": never,
+        "stuck_ranks": stuck,
+        "detected_by_ranks": detected_by,
+        "deadline_s": max((h.get("deadline_s") or 0.0)
+                          for h in hang_events) if hang_events else None,
+        "waited_s": max((h.get("waited_s") or 0.0)
+                        for h in hang_events) if hang_events else None,
+    }
+    if never:
+        out["culprit_rank"] = never[0]
+        out["culprit_reason"] = "never-arrived"
+    elif stuck and len(stuck) < len(arrived):
+        # some ranks completed the step, these armed and never did: they
+        # wedged inside their own collective window (the self-abort shape
+        # — independent replicas, or a rank that died mid-collective)
+        out["culprit_rank"] = stuck[0]
+        out["culprit_reason"] = "never-completed"
+    elif arrived:
+        # every rank reached its dispatch and none finished: the hang is
+        # in the fabric — suspect the rank that arrived last, using
+        # aligned clocks so a constant clock offset can't frame an
+        # innocent rank
+        ts = {r: marks[r][0][step] - align.offsets_s.get(r, 0.0)
+              for r in arrived}
+        out["culprit_rank"] = max(ts, key=ts.get)
+        out["culprit_reason"] = "last-to-arm"
+        if len(ts) >= 2:
+            out["arm_skew_s"] = round(max(ts.values()) - min(ts.values()), 6)
+    return out
+
+
 def _last_event_data(records: Sequence[Dict[str, Any]],
                      name: str) -> Optional[Dict[str, Any]]:
     for rec in reversed(records):
@@ -459,6 +560,9 @@ class PodReport:
     census_rank: Optional[int]
     census_total_bytes: Optional[int]
     measured_xla_bytes: Optional[int]
+    #: collective-hang attribution (attribute_comm_hang): which rank never
+    #: arrived at the fatal step's dispatch — None when the run saw none
+    comm_hang: Optional[Dict[str, Any]] = None
     source_files: Dict[int, str] = field(default_factory=dict)
 
     # ------------------------------------------------------------- schema
@@ -502,6 +606,7 @@ class PodReport:
                        "total_bytes_per_step": self.census_total_bytes,
                        "measured_xla_bytes": self.measured_xla_bytes,
                        "bytes_match": self.bytes_match},
+            "comm_hang": self.comm_hang,
         }
 
     # ------------------------------------------------------------- events
@@ -527,6 +632,13 @@ class PodReport:
                 ev.append((f"Pod/bw.{cls}_gbps", d["effective_gbps"], step))
         for rank, count in sorted(self.straggler_counts.items()):
             ev.append((f"Pod/straggler.rank{rank}", float(count), step))
+        if self.comm_hang is not None:
+            if self.comm_hang.get("step") is not None:
+                ev.append(("Pod/comm_hang.step",
+                           float(self.comm_hang["step"]), step))
+            if self.comm_hang.get("culprit_rank") is not None:
+                ev.append(("Pod/comm_hang.culprit_rank",
+                           float(self.comm_hang["culprit_rank"]), step))
         return ev
 
     def publish(self, registry: Any = None, monitor: Any = None,
@@ -602,6 +714,29 @@ class PodReport:
                 out.append(
                     f"  rank{rank:<4}{self.straggler_counts[rank]:>12}"
                     f"{_fmt_s(self.straggler_lateness_s.get(rank, 0.0)):>16}")
+
+        if self.comm_hang is not None:
+            h = self.comm_hang
+            out.append("")
+            out.append("collective hang (watchdog abort)")
+            who = (f"rank{h['culprit_rank']} ({h.get('culprit_reason')})"
+                   if h.get("culprit_rank") is not None else "unattributed")
+            out.append(f"  step {h['step']}: culprit {who}")
+            out.append(f"  armed (arrived at dispatch): "
+                       f"{h.get('arrived_ranks')}  never arrived: "
+                       f"{h.get('never_arrived_ranks')}")
+            detail = []
+            if h.get("deadline_s") is not None:
+                detail.append(f"deadline {h['deadline_s']:.1f}s")
+            if h.get("waited_s") is not None:
+                detail.append(f"waited {h['waited_s']:.1f}s")
+            if h.get("arm_skew_s") is not None:
+                detail.append(f"arm skew {_fmt_s(h['arm_skew_s'])}")
+            if h.get("detected_by_ranks"):
+                detail.append(f"detected by rank(s) "
+                              f"{h['detected_by_ranks']}")
+            if detail:
+                out.append(f"  {', '.join(detail)}")
 
         out.append("")
         out.append("comm/compute decomposition")
@@ -787,6 +922,7 @@ def fuse_pod(streams: Dict[int, RankStream],
         census_rank=census_rank,
         census_total_bytes=census_total,
         measured_xla_bytes=measured,
+        comm_hang=attribute_comm_hang(streams, align, spans),
         source_files={r: s.path for r, s in streams.items()},
     )
 
@@ -824,6 +960,11 @@ def validate_pod_report(d: Dict[str, Any]) -> List[str]:
                   "effective_gbps"):
             if k not in row:
                 problems.append(f"class {cls} missing {k}")
+    ch = d.get("comm_hang")
+    if ch is not None:
+        for k in ("step", "arrived_ranks", "never_arrived_ranks"):
+            if k not in ch:
+                problems.append(f"comm_hang missing {k}")
     return problems
 
 

@@ -70,7 +70,13 @@ class ResilienceCounters:
     NAMES = ("io_retries", "io_giveups", "corrupt_tags_skipped",
              "fallback_loads", "emergency_saves", "preemptions",
              "staging_sweeps", "staging_promotions", "checkpoints_rotated",
-             "restarts", "hang_restarts")
+             "restarts", "hang_restarts",
+             # pod fault tolerance (PR 9): two-phase commit protocol,
+             # collective-hang watchdog (rc 218) and the elastic agent's
+             # prompt sibling teardown — per-cause, so operators can tell a
+             # flaky interconnect from a preemption storm at a glance
+             "pod_commits", "torn_pod_quarantined", "comm_hang_aborts",
+             "comm_hang_restarts", "pod_teardowns")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -117,6 +123,10 @@ EVENT_NAMES = frozenset(
      "Memory/bytes_in_use", "Memory/peak_bytes_in_use",
      "Compile/count", "Compile/total_s",
      "Ckpt/save_s", "Ckpt/bytes_written",
+     # two-phase all-ranks commit (checkpoint/engine.py::pod_commit):
+     # cumulative seconds spent in phase-1 manifest writes + the
+     # cross-process barrier + the rank-0 commit-record write
+     "Ckpt/pod_commit_s",
      # SLA serving policy (inference/v2/serving.py — admission gate,
      # slack scheduler, KV-pressure eviction; docs/serving.md): queue
      # depth / KV-pool occupancy / live-stream gauges, admission outcome
@@ -758,6 +768,11 @@ class Telemetry:
         # anchored engines in one process get distinct epochs
         self._anchor_seq = 0
         self._last_textfile: Optional[float] = None
+        # the engine parks its CollectiveWatchdog (comm/watchdog.py) here
+        # so close() stops the poll thread — engines have no teardown of
+        # their own, and a leaked 4 Hz daemon per engine adds up in
+        # multi-engine processes
+        self.watchdog: Any = None
         self.heartbeat: Optional[Heartbeat] = None
         if cfg.heartbeat_enabled:
             self.heartbeat = Heartbeat(
@@ -980,6 +995,9 @@ class Telemetry:
         ckpt_hist = snap["histograms"].get("ckpt_save_s")
         if ckpt_hist and ckpt_hist["count"]:
             ev.append(("Ckpt/save_s", ckpt_hist["sum"], step))
+        commit_hist = snap["histograms"].get("ckpt_pod_commit_s")
+        if commit_hist and commit_hist["count"]:
+            ev.append(("Ckpt/pod_commit_s", commit_hist["sum"], step))
         return ev
 
     def dump(self, reason: str = "manual") -> List[Dict[str, Any]]:
@@ -1022,6 +1040,11 @@ class Telemetry:
         try:
             self.dump(reason)
         finally:
+            if self.watchdog is not None:
+                try:
+                    self.watchdog.stop()
+                except Exception:  # pragma: no cover - defensive
+                    pass
             if get_active_recorder() is self.recorder:
                 set_active_recorder(None)
 
@@ -1036,11 +1059,14 @@ def build_telemetry(config: Any, monitor: Any) -> Optional[Telemetry]:
     forced = os.environ.get("DSTPU_TELEMETRY", "").lower() in ("1", "true")
     if not (tcfg.enabled or forced):
         return None
-    import jax
-
     from .monitor import JsonlMonitor
+    from ..utils.podid import pod_rank
 
-    rank = jax.process_index()
+    # pod identity, not jax.process_index: an env-declared pod of
+    # independent single-controller replicas (utils/podid.py) must still
+    # write DISTINCT flightrec_rank<N>.jsonl / heartbeat files, or the pod
+    # report and the agent's heartbeat glob see one rank where N exist
+    rank = pod_rank()
     jsonl = next((m for m in monitor.monitors
                   if isinstance(m, JsonlMonitor)), None)
     if jsonl is None:

@@ -336,6 +336,15 @@ class TelemetryConfig:
     # are scraped off this file instead of anyone tailing JSONL
     textfile_enabled: bool = False
     textfile_interval_s: float = 15.0
+    # Collective hang watchdog (comm/watchdog.py): the engine arms a
+    # deadline around each step's collective dispatch; on expiry the
+    # watchdog thread dumps stacks, flushes the recorder and exits rc 218
+    # (the comm-hang contract the elastic agent restarts distinctly).
+    # warmup_deadline_s covers the first (compiling) step; None = 10x.
+    watchdog_enabled: bool = False
+    watchdog_deadline_s: float = 60.0
+    watchdog_warmup_deadline_s: Optional[float] = None
+    watchdog_poll_s: float = 0.25
     trace_start_step: Optional[int] = None
     trace_num_steps: int = 3
     trace_dir: Optional[str] = None
@@ -345,6 +354,7 @@ class TelemetryConfig:
         hb = dict(d.get("heartbeat", {}))
         tr = dict(d.get("trace", {}))
         tf = dict(d.get("textfile", {}))
+        wd = dict(d.get("watchdog", {}))
         ring = int(d.get("ring_size", 4096))
         if ring <= 0:
             raise ValueError(f"telemetry.ring_size must be > 0, got {ring}")
@@ -352,6 +362,18 @@ class TelemetryConfig:
         if tf_interval <= 0:
             raise ValueError(f"telemetry.textfile.interval_s must be > 0, "
                              f"got {tf_interval}")
+        wd_deadline = float(wd.get("deadline_s", 60.0))
+        wd_poll = float(wd.get("poll_s", 0.25))
+        if wd_deadline <= 0 or wd_poll <= 0:
+            raise ValueError(
+                f"telemetry.watchdog deadline_s/poll_s must be > 0, got "
+                f"{wd_deadline}/{wd_poll}")
+        wd_warmup = wd.get("warmup_deadline_s")
+        if wd_warmup is not None and float(wd_warmup) < wd_deadline:
+            raise ValueError(
+                f"telemetry.watchdog.warmup_deadline_s ({wd_warmup}) must "
+                f"cover at least deadline_s ({wd_deadline}) — the first "
+                f"armed step includes compilation")
         start = tr.get("start_step")
         return cls(
             enabled=bool(d.get("enabled", False)),
@@ -365,6 +387,11 @@ class TelemetryConfig:
             sync_timing=bool(d.get("sync_timing", False)),
             textfile_enabled=bool(tf.get("enabled", False)),
             textfile_interval_s=tf_interval,
+            watchdog_enabled=bool(wd.get("enabled", False)),
+            watchdog_deadline_s=wd_deadline,
+            watchdog_warmup_deadline_s=(None if wd_warmup is None
+                                        else float(wd_warmup)),
+            watchdog_poll_s=wd_poll,
             goodput_enabled=bool(d.get("goodput", {}).get("enabled", True)
                                  if isinstance(d.get("goodput"), dict)
                                  else d.get("goodput", True)),

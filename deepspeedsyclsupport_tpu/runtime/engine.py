@@ -42,6 +42,7 @@ from .optimizers import build_optimizer, current_lr
 from ..checkpoint.engine import LATEST_FILE
 from ..comm.comms_logging import comms_logger
 from ..comm.topology import MeshTopology, build_topology
+from ..utils.fault_injection import get_fault_injector
 from ..monitor import MonitorMaster
 from ..utils.logging import log_dist, logger
 from ..utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER,
@@ -327,6 +328,34 @@ class Engine:
             # instant through its own wall clock — the pod aggregator's
             # clock-offset ground truth. Single-process: a local marker.
             self.telemetry.anchor("engine_init")
+        # Collective hang watchdog (comm/watchdog.py): a deadline armed
+        # around each step's collective dispatch; expiry = stack dump +
+        # recorder flush + rc-218 exit, the comm-hang contract the elastic
+        # agent restarts distinctly from crash and preemption.
+        self._watchdog = None
+        # pod identity (utils/podid.py): jax.process_index under real
+        # multi-controller, the env-declared RANK for pods of independent
+        # single-controller replicas — rank-targeted fault injection and
+        # the watchdog's rank labeling both key on it
+        from ..utils.podid import pod_rank
+
+        self._fi_rank = pod_rank()
+        tw = self.config.telemetry
+        if self.telemetry is not None and tw.watchdog_enabled:
+            from ..comm.watchdog import CollectiveWatchdog
+
+            self._watchdog = CollectiveWatchdog(
+                deadline_s=tw.watchdog_deadline_s,
+                warmup_deadline_s=tw.watchdog_warmup_deadline_s,
+                poll_s=tw.watchdog_poll_s,
+                rank=self._fi_rank,
+                telemetry=self.telemetry,
+                stack_path=os.path.join(
+                    tw.output_dir, f"stacks_rank{self._fi_rank}.txt"),
+            ).start()
+            # telemetry.close() owns shutdown of the poll thread (engines
+            # have no teardown of their own)
+            self.telemetry.watchdog = self._watchdog
 
         # -------------------------------------------- activation checkpointing
         # (reference runtime/activation_checkpointing/: config-driven
@@ -512,6 +541,12 @@ class Engine:
                     if callable(self.lr_schedule) else self.lr_schedule)),
                 fp16_cfg=fp16, fp16_enabled=self.fp16_enabled,
                 swapper=mh_swapper)
+            # the host CPU Adam runs the loss-scale state machine on host
+            # (host_update_loss_scale): keep the state numpy-resident so
+            # its per-step scale read is a plain float, never a device sync
+            from .loss_scaler import host_loss_scale_state
+
+            self.scaler_state = host_loss_scale_state(self.scaler_state)
             self.master_params = None
             self.opt_state = None
             self.opt_shardings = None
@@ -521,10 +556,12 @@ class Engine:
         self._cpu_device = cpu
 
         def to_master(x):
-            x = np.asarray(jax.device_get(x))
+            # async transfer to the host device (no blocking device_get
+            # round trip); the fp32 promotion then runs on the host backend
+            x = jax.device_put(x, cpu)
             if jnp.issubdtype(x.dtype, jnp.floating):
-                x = x.astype(np.float32)
-            return jax.device_put(x, cpu)
+                x = x.astype(jnp.float32)
+            return x
 
         self.master_params = jax.tree_util.tree_map(to_master, params)
         self.params = self._push_params_to_device(params)
@@ -562,13 +599,15 @@ class Engine:
 
     def _push_params_to_device(self, master_tree):
         """Compute-dtype device working copies from the fp32 host master.
-        device_put straight from numpy: staging through jnp.asarray would
-        transiently commit each full leaf to the default device."""
+        The cast runs where each leaf already lives (the host backend for
+        cpu-committed masters, numpy for raw init trees) and the transfer
+        is an async ``device_put`` — no blocking ``device_get`` round trip
+        and no transient commit to the default device (this runs once per
+        step on the offload path)."""
         dtype = self.compute_dtype
 
         def push(x, s):
-            x = np.asarray(jax.device_get(x))
-            if jnp.issubdtype(x.dtype, jnp.floating):
+            if jnp.issubdtype(jnp.result_type(x), jnp.floating):
                 x = x.astype(dtype)
             return jax.device_put(x, s)
 
@@ -680,9 +719,11 @@ class Engine:
             return m2
         if self._host_apply is None:
             self._host_apply = self._build_host_apply_fn()
+        # async device->host transfers (XLA gathers shards in flight); the
+        # old device_get round trip blocked the dispatch pipeline here every
+        # step — the host apply below is the only consumer that must wait
         host_grads = jax.tree_util.tree_map(
-            lambda g: jax.device_put(np.asarray(jax.device_get(g)),
-                                     self._cpu_device), grads)
+            lambda g: jax.device_put(g, self._cpu_device), grads)
         if self._swapper is not None and self.opt_state is None:
             self._swap_in_opt_state()
         scaler = jax.device_put(self.scaler_state, self._cpu_device)
@@ -886,44 +927,84 @@ class Engine:
             self._trace_origin = "config"
         self.tput_timer.start()
         rng = jax.random.fold_in(self._rng, self.global_steps)
+        fi = get_fault_injector()
+        # this call executes what will be recorded as step global_steps+1
+        # (the counter increments after dispatch): arm/hang stamps use that
+        # number so they join exactly against the step span in the stream
+        stepno = self.global_steps + 1
+        if fi.armed:
+            # rank-targeted comm-layer fault (utils/fault_injection.py): a
+            # hang HERE is "this rank never arrives at the collective" —
+            # siblings spin inside the all-reduce and only their watchdogs
+            # (or the agent's teardown) end the pod
+            fi.maybe_hang_step(self._fi_rank, stepno)
+        if self._watchdog is not None:
+            # pre-dispatch deadline stamp: the collective phase is armed
+            # until the step's results are back (disarm in the finally
+            # below — an exception mid-dispatch must not leave the deadline
+            # live, or the watchdog would rc-218 the process ~deadline_s
+            # later while the caller handles an ordinary error)
+            self._watchdog.arm(stepno)
         t_step = time.perf_counter()
-        if self.offload_device is not None:
-            metrics = self._offload_train_batch(batch, rng)
-        else:
-            # abstract avals (+ shardings) of EXACTLY this step's args —
-            # curriculum truncation, gas reshape and pld_theta included —
-            # so the compiled program can be re-lowered (a compile-cache
-            # hit) for HLO-level comms accounting and graph_report without
-            # holding the donated arrays. Avals only carry
-            # shape/dtype/sharding, and params/opt/scaler keep theirs
-            # across steps, so the full O(param-leaves) tree_map reruns
-            # only when the batch/rng metadata actually changes (curriculum
-            # truncation step, gas reshape) — not every step.
-            key = (jax.tree_util.tree_structure((batch, rng)), tuple(
-                (jnp.shape(x), jnp.result_type(x),
-                 getattr(x, "sharding", None))
-                for x in jax.tree_util.tree_leaves((batch, rng))))
-            if key != getattr(self, "_last_aval_key", None) or \
-                    getattr(self, "_last_train_avals", None) is None:
-                from ..analysis.capture import abstract_step_args
+        try:
+            if fi.armed:
+                # phase="in": the rank ARRIVED (armed) and then wedged
+                # inside its collective window — this rank's own watchdog
+                # fires, exercising the self-abort half of the rc-218
+                # contract
+                fi.maybe_hang_step(self._fi_rank, stepno, phase="in")
+            if self.offload_device is not None:
+                metrics = self._offload_train_batch(batch, rng)
+            else:
+                # abstract avals (+ shardings) of EXACTLY this step's args —
+                # curriculum truncation, gas reshape and pld_theta included —
+                # so the compiled program can be re-lowered (a compile-cache
+                # hit) for HLO-level comms accounting and graph_report
+                # without holding the donated arrays. Avals only carry
+                # shape/dtype/sharding, and params/opt/scaler keep theirs
+                # across steps, so the full O(param-leaves) tree_map reruns
+                # only when the batch/rng metadata actually changes
+                # (curriculum truncation step, gas reshape) — not every step.
+                key = (jax.tree_util.tree_structure((batch, rng)), tuple(
+                    (jnp.shape(x), jnp.result_type(x),
+                     getattr(x, "sharding", None))
+                    for x in jax.tree_util.tree_leaves((batch, rng))))
+                if key != getattr(self, "_last_aval_key", None) or \
+                        getattr(self, "_last_train_avals", None) is None:
+                    from ..analysis.capture import abstract_step_args
 
-                self._last_train_avals = abstract_step_args(
-                    (self.params, self.opt_state, self.scaler_state,
-                     batch, rng))
-                self._last_aval_key = key
-            self.params, self.opt_state, self.scaler_state, metrics = \
-                self._train_batch_fn(self.params, self.opt_state,
-                                     self.scaler_state, batch, rng)
-        if comms_logger.enabled:
-            # opt-in (comms_logger.enabled): straggler wall-clock must be
-            # device-accurate, so this config knowingly trades the overlap
-            jax.block_until_ready(metrics["loss"])  # dslint: allow(host-sync-in-step-path)
-            comms_logger.record_wall("train_batch",
-                                     time.perf_counter() - t_step)
-        elif self.telemetry is not None and self.telemetry.cfg.sync_timing:
-            # telemetry.sync_timing: device-accurate step spans — trades the
-            # dispatch/compute overlap for timing fidelity (see on_step_end)
-            jax.block_until_ready(metrics["loss"])  # dslint: allow(host-sync-in-step-path)
+                    self._last_train_avals = abstract_step_args(
+                        (self.params, self.opt_state, self.scaler_state,
+                         batch, rng))
+                    self._last_aval_key = key
+                self.params, self.opt_state, self.scaler_state, metrics = \
+                    self._train_batch_fn(self.params, self.opt_state,
+                                         self.scaler_state, batch, rng)
+            if comms_logger.enabled:
+                # opt-in (comms_logger.enabled): straggler wall-clock must
+                # be device-accurate, so this config knowingly trades the
+                # overlap
+                jax.block_until_ready(metrics["loss"])  # dslint: allow(host-sync-in-step-path)
+                comms_logger.record_wall("train_batch",
+                                         time.perf_counter() - t_step)
+            elif self.telemetry is not None and self.telemetry.cfg.sync_timing:
+                # telemetry.sync_timing: device-accurate step spans — trades
+                # the dispatch/compute overlap for timing fidelity (see
+                # on_step_end)
+                jax.block_until_ready(metrics["loss"])  # dslint: allow(host-sync-in-step-path)
+            # NOTE (watchdog + async dispatch): with neither sync knob on,
+            # the jitted call can return before the device work runs, so a
+            # purely device-side hang is caught when XLA's bounded
+            # in-flight queue blocks a LATER dispatch — still inside an
+            # armed window, so rc-218 fires, but attribution may name a
+            # step a few later than the wedged one. telemetry.sync_timing
+            # opts into device-accurate (exact-step) windows at the
+            # documented cost of the dispatch/compute overlap.
+        finally:
+            if self._watchdog is not None:
+                # post-dispatch: the step span recorded in on_step_end
+                # below is the durable post record the pod report joins
+                self._watchdog.disarm(stepno)
         step_dur = time.perf_counter() - t_step
         self.global_steps += 1
         self.micro_steps += gas
@@ -949,6 +1030,21 @@ class Engine:
                 self._train_batch_raw,
                 (self.params, self.opt_state, self.scaler_state, batch, rng))
         self._post_step(metrics)
+        if fi.armed:
+            rc = fi.should_kill(self._fi_rank, self.global_steps)
+            if rc is not None:
+                # a hard crash, not a preemption: no emergency save, no
+                # cleanup — the elastic agent's prompt-teardown path is
+                # what this fault exists to exercise
+                logger.error("fault injection: rank %d dying with rc=%d "
+                             "after step %d", self._fi_rank, rc,
+                             self.global_steps)
+                if self.telemetry is not None:
+                    try:
+                        self.telemetry.dump("injected_kill")
+                    except Exception:
+                        pass
+                os._exit(rc)
         return metrics
 
     def start_profile(self, trace_dir: Optional[str] = None) -> None:
@@ -1372,7 +1468,7 @@ class Engine:
             meta["qat"] = self.qat_scheduler.state_dict()
         post_commit = None
         keep = self.config.checkpoint.keep_last_n
-        if keep and jax.process_index() == 0:
+        if keep and self._fi_rank == 0:
             from ..checkpoint.engine import rotate_checkpoints
 
             # rotation rides the engine's post-commit hook so it only ever
@@ -1428,9 +1524,10 @@ class Engine:
         load_tree = self.checkpoint_engine.load
         # before resolving `latest`: an async save may still be writing it
         self.checkpoint_engine.wait()
-        if jax.process_index() == 0:
+        if self._fi_rank == 0:
             # a worker killed mid-save before this restart left .staging-*
-            # orphans behind; resume is the natural sweep point
+            # orphans (and possibly a torn-pod tag) behind; resume is the
+            # natural sweep point, and pod rank 0 owns shared-dir hygiene
             from ..checkpoint.ckpt_engine import sweep_staging_dirs
 
             sweep_staging_dirs(load_dir)
@@ -1466,7 +1563,11 @@ class Engine:
                           state["opt_state"] if load_optimizer_states
                           else None)
             if load_optimizer_states:
-                self.scaler_state = state["scaler"]
+                # back to host-numpy residence (see _init_offload): the
+                # restore device_put the scaler to the mesh like any leaf
+                from .loss_scaler import host_loss_scale_state
+
+                self.scaler_state = host_loss_scale_state(state["scaler"])
             self.params = self._mh_push(mh.master_global_tree())
         elif self.offload_device is not None:
             if self._swapper is not None and self.opt_state is None:
@@ -1531,12 +1632,13 @@ class Engine:
                 os.path.join(load_dir, pointed, "mp_rank_*_model_states.pt")):
             # a REFERENCE-format (torch .pt layout) checkpoint carries no
             # dstpu manifest to verify; hand it to the importer untouched
-            return pointed
+            return self._agree_resume_tag(pointed)
         tag, skipped = find_latest_valid_tag(load_dir, deep=False)
         for skipped_tag, reason in skipped:
             logger.warning("skipping corrupt checkpoint %s: %s",
                            os.path.join(load_dir, skipped_tag), reason)
             resilience_counters.incr("corrupt_tags_skipped")
+        tag = self._agree_resume_tag(tag)
         if tag is None:
             logger.warning("no loadable checkpoint in %s; nothing loaded",
                            load_dir)
@@ -1546,6 +1648,38 @@ class Engine:
             logger.warning("fallback load: resuming %s (latest pointer was "
                            "%r)", os.path.join(load_dir, tag), pointed)
         return tag
+
+    # one fixed-size slot per rank: the agreement collective must have a
+    # static shape, so tags are padded/truncated to this many bytes
+    _TAG_AGREE_BYTES = 256
+
+    def _agree_resume_tag(self, tag: Optional[str]) -> Optional[str]:
+        """Barrier-agreed resume tag: every rank allgathers its locally
+        resolved candidate and adopts rank 0's. Resolution reads a shared
+        directory, so ranks *usually* agree — but a save/quarantine racing
+        a restart can split the view, and a pod whose ranks resume
+        different steps silently diverges forever. The allgather doubles
+        as the resume barrier: no rank starts loading until every rank has
+        resolved. Single-process: identity."""
+        if jax.process_count() == 1:
+            return tag
+        from jax.experimental import multihost_utils  # pragma: no cover
+
+        buf = np.zeros(self._TAG_AGREE_BYTES, np.uint8)
+        enc = (tag or "").encode()[:self._TAG_AGREE_BYTES]
+        buf[:len(enc)] = np.frombuffer(enc, np.uint8)
+        rows = np.asarray(multihost_utils.process_allgather(buf))
+        agreed = bytes(rows.reshape(jax.process_count(), -1)[0]) \
+            .rstrip(b"\x00").decode() or None
+        if agreed != tag:
+            from ..monitor.monitor import resilience_counters
+
+            logger.warning(
+                "resume-tag divergence: this rank resolved %r but the pod "
+                "agreed on rank 0's %r — adopting the pod's choice", tag,
+                agreed)
+            resilience_counters.incr("fallback_loads")
+        return agreed
 
     def save_16bit_model(self, save_dir: str,
                          checkpoint_name: str = "mp_rank_00_model_states.pt"

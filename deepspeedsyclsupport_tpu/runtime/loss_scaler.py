@@ -14,6 +14,7 @@ from typing import Any, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 class LossScaleState(NamedTuple):
@@ -69,6 +70,48 @@ def update_loss_scale(state: LossScaleState, finite: jnp.ndarray, *,
         hysteresis_left=hys.astype(jnp.int32),
         overflows=state.overflows + (~finite).astype(jnp.int32),
     )
+
+
+def host_loss_scale_state(state: LossScaleState) -> LossScaleState:
+    """Host-resident (numpy) copy of a scaler state. The offloaded CPU
+    optimizer runs the scale state machine entirely on host, so its
+    per-step scale reads must be plain floats — pulling a device scalar
+    every step is exactly the host sync the step path must not pay. Called
+    at engine init / checkpoint load (both sanctioned sync sites), never
+    per step."""
+    return LossScaleState(*(np.asarray(v) for v in state))
+
+
+def host_update_loss_scale(state: LossScaleState, finite: bool, *,
+                           dynamic: bool, scale_window: int,
+                           scale_factor: float = 2.0, min_scale: float = 1.0,
+                           hysteresis: int = 2) -> LossScaleState:
+    """:func:`update_loss_scale` for host (numpy) state: the identical
+    transition in plain Python arithmetic, so the offloaded step performs
+    zero device work for loss scaling. Kept in lockstep with the jnp
+    version — the multi-process parity test compares the two paths'
+    trajectories bit-for-bit."""
+    finite = bool(finite)
+    overflows = np.int32(int(state.overflows) + (0 if finite else 1))
+    if not dynamic:
+        return state._replace(overflows=overflows)
+    scale = float(state.scale)
+    good = int(state.good_steps)
+    hys = int(state.hysteresis_left)
+    if finite:
+        good += 1
+        if good >= scale_window:
+            scale *= scale_factor
+            good = 0
+            hys = hysteresis
+    else:
+        hys -= 1
+        if hys <= 0:
+            scale = max(scale / scale_factor, min_scale)
+            hys = hysteresis
+        good = 0
+    return LossScaleState(scale=np.float32(scale), good_steps=np.int32(good),
+                          hysteresis_left=np.int32(hys), overflows=overflows)
 
 
 def scale_loss(loss, state: LossScaleState):

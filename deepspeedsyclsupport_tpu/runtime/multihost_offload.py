@@ -22,10 +22,9 @@ implements Adam/AdamW; other optimizer types raise at engine init.
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
-from .loss_scaler import LossScaleState, update_loss_scale
+from .loss_scaler import LossScaleState, host_update_loss_scale
 from ..utils.logging import log_dist
 
 __all__ = ["MultiHostCPUAdam"]
@@ -154,7 +153,10 @@ class MultiHostCPUAdam:
                     for k in keys:
                         self.swapper.prefetch(f"{which}/{li}/{k}")
         g_leaves = jax.tree_util.tree_leaves(grads)
-        scale = float(np.asarray(jax.device_get(scaler.scale)))
+        # the scaler state is HOST-resident on this path (the engine
+        # converts it at init / checkpoint load via host_loss_scale_state):
+        # reading the scale is a plain float, not a per-step device sync
+        scale = float(scaler.scale)
         local_g: list = []
         sq = 0.0
         finite = True
@@ -217,15 +219,17 @@ class MultiHostCPUAdam:
                         self.swapper.swap_out(f"v/{li}/{k}", v)
 
         fp16 = self.fp16_cfg
-        new_scaler = update_loss_scale(
-            scaler, jnp.asarray(finite),
+        # host-side transition (loss_scaler.host_update_loss_scale): same
+        # state machine as the jitted path, zero device work
+        new_scaler = host_update_loss_scale(
+            scaler, finite,
             dynamic=bool(self.fp16_enabled and fp16 is not None
                          and fp16.dynamic),
             scale_window=(fp16.loss_scale_window if fp16 else 1000),
             min_scale=(fp16.min_loss_scale if fp16 else 1.0),
             hysteresis=(fp16.hysteresis if fp16 else 2))
         metrics = {"grad_norm": grad_norm, "finite": finite,
-                   "loss_scale": float(np.asarray(new_scaler.scale))}
+                   "loss_scale": float(new_scaler.scale)}
         return self.master_global_tree(), new_scaler, metrics
 
     # ---------------------------------------------------------------- helpers

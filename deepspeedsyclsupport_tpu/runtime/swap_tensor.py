@@ -11,6 +11,15 @@ latency with compute — the same swap-in-ahead pattern ZeRO-3's NVMe path uses
 Device arrays are pulled to host numpy at swap-out; swap-in returns numpy and
 the caller re-places onto the mesh (``jax.device_put`` against its sharding) —
 placement stays the engine's concern, matching the layering upstream.
+
+Fault path: every IO completion point rides
+:func:`~..utils.fault_injection.retry_io` (capped exponential backoff +
+jitter, ``Resilience/io_retries`` counted), and a failed request is
+*re-issued*, not just re-awaited — the host copy of an un-durable write is
+retained until its completion is confirmed, so a transient NVMe/FS blip
+degrades an offloaded step to a retry instead of killing the run. The
+retained copy costs nothing extra: the aio handle already pins the buffer
+until the request is reaped.
 """
 import os
 from dataclasses import dataclass
@@ -19,6 +28,7 @@ from typing import Any, Dict, Optional
 import jax
 import numpy as np
 
+from ..utils.fault_injection import get_fault_injector, retry_io
 from ..utils.logging import logger
 
 
@@ -28,6 +38,7 @@ class _SwapEntry:
     shape: tuple
     dtype: Any
     write_req: Optional[int] = None   # in-flight write
+    write_buf: Optional[np.ndarray] = None  # host copy until write durable
     read_req: Optional[int] = None    # in-flight prefetch
     read_buf: Optional[np.ndarray] = None
 
@@ -46,7 +57,8 @@ class AsyncTensorSwapper:
     # ------------------------------------------------------------------ out
     def swap_out(self, name: str, tensor) -> None:
         """Start an async write; returns immediately. The host copy stays
-        referenced by the aio handle until the write completes."""
+        referenced (entry + aio handle) until the write is confirmed
+        durable, so a failed write can be re-issued by the retry path."""
         import hashlib
 
         arr = np.asarray(jax.device_get(tensor))
@@ -65,10 +77,37 @@ class AsyncTensorSwapper:
                         self.handle.wait(req)
                     except OSError:
                         pass
-        e = _SwapEntry(path=path, shape=arr.shape, dtype=arr.dtype)
-        # whole-file rewrite: a shrinking tensor must not leave stale tail bytes
-        e.write_req = self.handle.pwrite(path, arr, truncate=True)
+        e = _SwapEntry(path=path, shape=arr.shape, dtype=arr.dtype,
+                       write_buf=arr)
+
+        def submit():
+            get_fault_injector().maybe_fail_write(path)
+            # whole-file rewrite: a shrinking tensor must not leave stale
+            # tail bytes
+            return self.handle.pwrite(path, arr, truncate=True)
+
+        e.write_req = retry_io(submit, what=f"swap write submit {name}")
         self._entries[name] = e
+
+    def _reap_write(self, name: str, e: _SwapEntry) -> None:
+        """Wait out the pending write; a failure re-submits from the
+        retained host copy (retry_io pacing + counters) — the entry's data
+        only becomes re-readable from disk once this returns."""
+        if e.write_req is None:
+            return
+
+        def unit():
+            if e.write_req is None:
+                # prior wait failed and reaped the request: re-issue the
+                # whole write from the retained host copy
+                get_fault_injector().maybe_fail_write(e.path)
+                e.write_req = self.handle.pwrite(e.path, e.write_buf,
+                                                 truncate=True)
+            req, e.write_req = e.write_req, None  # wait() reaps even on fail
+            self.handle.wait(req)
+
+        retry_io(unit, what=f"swap write {name}")
+        e.write_buf = None  # durable: release the host copy
 
     # ------------------------------------------------------------------- in
     def prefetch(self, name: str) -> None:
@@ -76,28 +115,32 @@ class AsyncTensorSwapper:
         e = self._require(name)
         if e.read_req is not None:
             return  # already in flight
-        if e.write_req is not None:
-            req, e.write_req = e.write_req, None  # clear first: wait() reaps
-            self.handle.wait(req)                 # even on failure
+        self._reap_write(name, e)
         e.read_buf = np.empty(e.shape, e.dtype)
-        e.read_req = self.handle.pread(e.path, e.read_buf)
+        # submission retried like swap_out's: a transient submit failure
+        # must degrade to a retry, not kill the prefetching step
+        e.read_req = retry_io(lambda: self.handle.pread(e.path, e.read_buf),
+                              what=f"swap read submit {name}")
 
     def retrieve(self, name: str) -> np.ndarray:
         e = self._require(name)
-        if e.read_req is None:
-            self.prefetch(name)
-        req, buf = e.read_req, e.read_buf
-        e.read_req, e.read_buf = None, None  # wait() reaps even on failure;
-        self.handle.wait(req)                # a retry must re-issue the read
-        return buf
+
+        def unit():
+            if e.read_req is None:
+                self.prefetch(name)  # re-issues the read after a failure
+            req, buf = e.read_req, e.read_buf
+            e.read_req, e.read_buf = None, None  # wait() reaps even on fail
+            self.handle.wait(req)
+            return buf
+
+        return retry_io(unit, what=f"swap read {name}")
 
     # ----------------------------------------------------------------- misc
     def synchronize(self) -> None:
-        """Drain all in-flight writes (checkpoint barrier)."""
-        for e in self._entries.values():
-            if e.write_req is not None:
-                req, e.write_req = e.write_req, None  # reaped even on failure
-                self.handle.wait(req)
+        """Drain all in-flight writes (checkpoint barrier) — each one
+        retried/re-issued on transient failure like any reap."""
+        for name, e in self._entries.items():
+            self._reap_write(name, e)
 
     def release(self, name: str) -> None:
         e = self._entries.pop(name, None)
@@ -109,6 +152,7 @@ class AsyncTensorSwapper:
                     self.handle.wait(req)
                 except OSError:
                     pass
+        e.write_buf = e.read_buf = None
         try:
             os.unlink(e.path)
         except OSError:

@@ -1,0 +1,181 @@
+"""Safe persistent compilation cache: per-process staging + atomic publish.
+
+PR 1 root-caused the seed suite's mid-run segfaults to jax's persistent
+compilation cache: concurrent writers (or a writer killed mid-``write(2)``)
+tear a cache entry in the shared directory, and every later process that
+deserializes the torn executable corrupts its heap. The cache was therefore
+turned OFF — but the ROADMAP says *fix rather than avoid*: the suite is
+compile-bound, and a pod of rank processes compiling the same programs is
+exactly the concurrent-writer shape that tears a naively shared directory.
+
+The fix is the classic staging/publish split, the same discipline the
+checkpoint layer already follows:
+
+* each process points jax at a **private staging dir**
+  (``<shared>/.proc-<pid>-<nonce>``) — no two writers ever share a file;
+* the staging dir is **seeded** from the shared dir at enable time
+  (hardlinks when possible, copies otherwise) so previously published
+  entries still hit;
+* new entries are **published** back by writing to a dotfile temp in the
+  shared dir and ``os.replace``-ing onto the final name — readers see an
+  entry either not at all or in full, never torn (rename atomicity on one
+  filesystem);
+* publish runs at interpreter exit (and on demand via
+  :func:`publish_cache_entries`); dead processes' stale staging dirs are
+  swept opportunistically.
+
+Entry names never start with ``.`` (jax uses content hashes), so dotfiles
+are safely ours: temps, staging dirs, and anything a killed publisher left
+behind are invisible to seeding and to jax.
+"""
+import atexit
+import os
+import shutil
+import uuid
+from typing import Optional
+
+from .logging import logger
+
+__all__ = ["enable_safe_persistent_cache", "publish_cache_entries",
+           "sweep_stale_staging"]
+
+_STAGING_PREFIX = ".proc-"
+_TMP_PREFIX = ".pub-"
+
+
+def _is_entry(name: str) -> bool:
+    """A real cache entry (jax content-hash filenames never start with a
+    dot; everything dotted is our machinery or a torn temp)."""
+    return bool(name) and not name.startswith(".")
+
+
+def enable_safe_persistent_cache(shared_dir: str,
+                                 min_compile_secs: float = 0.5,
+                                 configure_jax: bool = True) -> str:
+    """Arm the jax persistent compilation cache against ``shared_dir``
+    safely, returning this process's private staging directory.
+
+    ``configure_jax=False`` skips the ``jax.config`` mutation (unit tests
+    exercise the seed/publish mechanics without retargeting the live
+    process's cache)."""
+    shared_dir = os.path.abspath(shared_dir)
+    staging = os.path.join(shared_dir,
+                           f"{_STAGING_PREFIX}{os.getpid()}-"
+                           f"{uuid.uuid4().hex[:8]}")
+    os.makedirs(staging, exist_ok=True)
+    sweep_stale_staging(shared_dir)
+    seeded = 0
+    try:
+        names = os.listdir(shared_dir)
+    except OSError:
+        names = []
+    for name in names:
+        if not _is_entry(name):
+            continue
+        src = os.path.join(shared_dir, name)
+        dst = os.path.join(staging, name)
+        if not os.path.isfile(src):
+            continue
+        try:
+            os.link(src, dst)  # O(1); published entries are immutable
+        except OSError:
+            try:
+                shutil.copy2(src, dst)  # cross-device / no-hardlink FS
+            except OSError as e:
+                logger.warning("compile cache: could not seed %s: %s",
+                               name, e)
+                continue
+        seeded += 1
+    if configure_jax:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", staging)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_secs))
+    atexit.register(publish_cache_entries, staging, shared_dir,
+                    cleanup=True)
+    logger.info("compile cache: staging %s over shared %s (%d entr%s "
+                "seeded)", staging, shared_dir, seeded,
+                "y" if seeded == 1 else "ies")
+    return staging
+
+
+def publish_cache_entries(staging: str, shared_dir: str,
+                          cleanup: bool = False) -> int:
+    """Atomically publish every entry in ``staging`` that the shared dir
+    doesn't have yet: write the bytes to a dotted temp *in the shared dir*
+    (same filesystem as the target — ``os.replace`` is only atomic there),
+    fsync, rename. A concurrent publisher of the same entry is harmless:
+    content is keyed by hash, so whoever renames last rewrites identical
+    bytes. Returns the number published; with ``cleanup`` the staging dir
+    is removed afterwards."""
+    published = 0
+    try:
+        names = os.listdir(staging)
+    except OSError:
+        return 0
+    for name in names:
+        if not _is_entry(name):
+            continue
+        src = os.path.join(staging, name)
+        dst = os.path.join(shared_dir, name)
+        if not os.path.isfile(src) or os.path.exists(dst):
+            continue
+        tmp = os.path.join(shared_dir,
+                           f"{_TMP_PREFIX}{os.getpid()}-{name}")
+        try:
+            with open(src, "rb") as fsrc, open(tmp, "wb") as fdst:
+                shutil.copyfileobj(fsrc, fdst)
+                fdst.flush()
+                os.fsync(fdst.fileno())
+            os.replace(tmp, dst)
+            published += 1
+        except OSError as e:
+            logger.warning("compile cache: publish of %s failed: %s",
+                           name, e)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    if cleanup:
+        shutil.rmtree(staging, ignore_errors=True)
+    return published
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except (OSError, PermissionError):  # pragma: no cover - exists, not ours
+        return True
+    return True
+
+
+def sweep_stale_staging(shared_dir: str) -> int:
+    """Remove staging dirs (and publish temps) left by dead processes — a
+    crashed worker must not leak its private dir forever. Live processes'
+    dirs are untouched (pid probe)."""
+    removed = 0
+    try:
+        names = os.listdir(shared_dir)
+    except OSError:
+        return 0
+    for name in names:
+        p = os.path.join(shared_dir, name)
+        pid: Optional[int] = None
+        if name.startswith(_STAGING_PREFIX) or name.startswith(_TMP_PREFIX):
+            tail = name.split("-", 2)
+            if len(tail) >= 2 and tail[1].isdigit():
+                pid = int(tail[1])
+        if pid is None or _pid_alive(pid):
+            continue
+        try:
+            if os.path.isdir(p):
+                shutil.rmtree(p)
+            else:
+                os.unlink(p)
+            removed += 1
+        except OSError:  # pragma: no cover - racing another sweeper
+            pass
+    return removed

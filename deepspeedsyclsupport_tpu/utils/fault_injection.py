@@ -15,7 +15,10 @@ Spec (programmatic dict or JSON in the ``DSTPU_FAULT_INJECTION`` env var):
 ``{"write_fail":  {"match": "state.bin", "count": 2},``
 ``  "truncate":   {"match": "state.bin", "keep_bytes": 64, "count": 1},``
 ``  "async_delay": 0.05,``
-``  "preempt_at_step": 3}``
+``  "preempt_at_step": 3,``
+``  "hang_step":   {"rank": 1, "step": 4, "seconds": 600},``
+``  "kill_step":   {"rank": 1, "step": 4, "rc": 1},``
+``  "tear_pod":    {"rank": 0, "skip": 1, "drop": "commit", "count": 1}}``
 
 * ``write_fail`` — the next ``count`` storage writes whose target path
   contains ``match`` raise a transient :class:`OSError` (``EIO``) before any
@@ -29,6 +32,24 @@ Spec (programmatic dict or JSON in the ``DSTPU_FAULT_INJECTION`` env var):
 * ``preempt_at_step`` — deliver one simulated preemption request at the
   first step boundary where ``global_steps >= N`` (consumed by
   ``runtime/resilience.py``), standing in for a real SIGTERM.
+
+Pod-scale (comm-layer) faults — rank-targeted and one-shot, so a chosen
+rank misbehaves deterministically while its siblings stay healthy:
+
+* ``hang_step`` — rank ``rank`` blocks for ``seconds`` (default forever,
+  i.e. until killed) *before dispatching* step ``step``'s collectives: the
+  rank never arrives at the all-reduce, every sibling spins inside it, and
+  the collective watchdog (``comm/watchdog.py``) is what ends the pod.
+  Consumed by the engine at the top of ``train_batch``.
+* ``kill_step`` — rank ``rank`` dies with ``os._exit(rc)`` (default 1) at
+  the step boundary *after* completing step ``step``: a hard crash with no
+  emergency save, exercising the agent's prompt sibling teardown.
+* ``tear_pod`` — tears the two-phase pod-commit record of a checkpoint
+  after the save claims durability: ``drop: "commit"`` deletes
+  ``dstpu_commit.json`` (phase 2 never happened), ``drop: "rank_manifest"``
+  deletes rank ``drop_rank``'s phase-1 manifest. ``skip`` healthy saves
+  pass through first; only the actor ``rank`` performs the teardown (the
+  files are shared). Consumed by ``checkpoint/engine.py::save_tree``.
 """
 import errno
 import json
@@ -59,10 +80,18 @@ class FaultInjector:
         self.async_delay = float(spec.get("async_delay") or 0.0)
         p = spec.get("preempt_at_step")
         self.preempt_at_step: Optional[int] = None if p is None else int(p)
+        self.hang_step = dict(spec.get("hang_step") or {})
+        self.kill_step = dict(spec.get("kill_step") or {})
+        self.tear_pod = dict(spec.get("tear_pod") or {})
         self._write_failures_left = int(self.write_fail.get("count", 0))
         self._truncates_left = int(self.truncate.get("count", 1)
                                    if self.truncate else 0)
+        self._tears_left = int(self.tear_pod.get("count", 1)
+                               if self.tear_pod else 0)
+        self._tear_skips_left = int(self.tear_pod.get("skip", 0))
         self._preempted = False
+        self._hung = False
+        self._killed = False
         self._lock = threading.Lock()
 
     @classmethod
@@ -78,7 +107,8 @@ class FaultInjector:
     @property
     def armed(self) -> bool:
         return bool(self.write_fail or self.truncate or self.async_delay
-                    or self.preempt_at_step is not None)
+                    or self.preempt_at_step is not None
+                    or self.hang_step or self.kill_step or self.tear_pod)
 
     # ------------------------------------------------------- injection points
     @staticmethod
@@ -128,6 +158,87 @@ class FaultInjector:
                 return False
             self._preempted = True
         return True
+
+    # -------------------------------------------------- pod (comm-layer) faults
+    def maybe_hang_step(self, rank: int, global_steps: int,
+                        phase: str = "pre") -> bool:
+        """One-shot rank-targeted stall in the step's collective window.
+
+        ``phase: "pre"`` (spec default) stalls BEFORE the watchdog arms —
+        the rank *never arrives* at the all-reduce; the siblings spin
+        inside it and their watchdogs (or the agent's teardown) end the
+        pod. ``phase: "in"`` stalls after arming — the rank arrived and
+        then wedged, so its OWN watchdog fires. Blocks for ``seconds``
+        (default: effectively forever; the process is expected to be
+        killed first). Returns whether it hung."""
+        with self._lock:
+            if self._hung or not self.hang_step:
+                return False
+            if self.hang_step.get("phase", "pre") != phase:
+                return False
+            if int(self.hang_step.get("rank", 0)) != int(rank):
+                return False
+            if global_steps < int(self.hang_step.get("step", 0)):
+                return False
+            self._hung = True
+        seconds = float(self.hang_step.get("seconds", 0) or 0)
+        deadline = (time.monotonic() + seconds) if seconds > 0 else None
+        logger.warning("fault injection: rank %d hanging %s step %d's "
+                       "collective window (%s)", rank,
+                       "inside" if phase == "in" else "before",
+                       global_steps,
+                       f"{seconds:.0f}s" if deadline else "until killed")
+        while deadline is None or time.monotonic() < deadline:
+            time.sleep(min(1.0, (deadline - time.monotonic())
+                           if deadline else 1.0))
+        return True
+
+    def should_kill(self, rank: int, global_steps: int) -> Optional[int]:
+        """One-shot hard-death request for this rank at a step boundary:
+        returns the exit code to die with (the caller ``os._exit``\\ s — no
+        emergency save, no cleanup; this is a crash, not a preemption)."""
+        with self._lock:
+            if self._killed or not self.kill_step:
+                return None
+            if int(self.kill_step.get("rank", 0)) != int(rank):
+                return None
+            if global_steps < int(self.kill_step.get("step", 0)):
+                return None
+            self._killed = True
+        return int(self.kill_step.get("rc", 1))
+
+    def maybe_tear_pod(self, path: str, rank: int) -> Optional[str]:
+        """Tear a pod checkpoint's two-phase commit after the save claimed
+        durability: delete the commit record (``drop: "commit"``) or one
+        rank's phase-1 manifest (``drop: "rank_manifest"`` +
+        ``drop_rank``). ``skip`` healthy saves pass first; only the actor
+        ``rank`` tears (the files are shared across ranks). Returns the
+        deleted path, or None."""
+        with self._lock:
+            if self._tears_left <= 0 or not self.tear_pod:
+                return None
+            if int(self.tear_pod.get("rank", 0)) != int(rank):
+                return None
+            if self._tear_skips_left > 0:
+                self._tear_skips_left -= 1
+                return None
+            self._tears_left -= 1
+        from ..checkpoint.engine import COMMIT_FILE, rank_manifest_name
+
+        if self.tear_pod.get("drop", "commit") == "rank_manifest":
+            victim = os.path.join(path, rank_manifest_name(
+                int(self.tear_pod.get("drop_rank", 0))))
+        else:
+            victim = os.path.join(path, COMMIT_FILE)
+        try:
+            os.unlink(victim)
+        except OSError as e:
+            logger.warning("fault injection: could not tear pod commit "
+                           "%s: %s", victim, e)
+            return None
+        logger.warning("fault injection: tore pod checkpoint %s (deleted "
+                       "%s)", path, os.path.basename(victim))
+        return victim
 
 
 # -------------------------------------------------------------- global access

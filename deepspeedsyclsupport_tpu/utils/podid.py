@@ -1,0 +1,47 @@
+"""Pod identity: which rank am I, how many ranks share this run's storage.
+
+Real multi-controller runs answer through ``jax.distributed``
+(``jax.process_index``/``process_count``). But a pod can equally be N
+*independent single-controller processes* sharing a checkpoint directory:
+the fake-backend test harness shape (CPU jaxlib has no multiprocess
+collectives), data-parallel replica fleets under an external launcher, and
+the elastic agent's local pod mode (``elastic_agent.py --nprocs``) all look
+like this. ``DSTPU_POD_RANKS`` declares such a pod's size; the standard
+``RANK`` env names the member. The checkpoint commit protocol, telemetry
+rank labeling and rank-targeted fault injection all resolve identity here,
+so both pod shapes get the same contracts.
+"""
+import os
+from typing import Tuple
+
+ENV_POD_RANKS = "DSTPU_POD_RANKS"
+
+
+def pod_identity() -> Tuple[int, int]:
+    """``(rank, world)``. jax.distributed wins when initialized; otherwise
+    an env-declared pod (``DSTPU_POD_RANKS`` + ``RANK``); otherwise the
+    solo default ``(0, 1)``. Malformed env degrades to solo rather than
+    crashing a training run over a bad launcher variable."""
+    import jax
+
+    if jax.process_count() > 1:
+        return jax.process_index(), jax.process_count()
+    try:
+        world = int(os.environ.get(ENV_POD_RANKS, "1") or 1)
+    except ValueError:
+        world = 1
+    if world > 1:
+        try:
+            rank = int(os.environ.get("RANK", "0") or 0)
+        except ValueError:
+            rank = 0
+        return rank, world
+    return 0, 1
+
+
+def pod_rank() -> int:
+    return pod_identity()[0]
+
+
+def pod_world() -> int:
+    return pod_identity()[1]

@@ -28,16 +28,21 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: the suite is compile-bound (VERDICT r2 weak
-# #6) and the cache used to be on by default — but its entry serialization is
-# unsafe in this environment: an interrupted/concurrent cache write corrupts
-# the process heap (mid-suite segfaults), and a torn entry then poisons every
-# later run that deserializes it (wrong executables → NaNs, deterministic
-# crashes at the same test). Resilience over speed: OFF unless a cache dir is
-# explicitly opted into via DSTPU_TEST_CACHE.
+# #6) and the cache used to be on by default — but jax's entry writes go
+# straight into the shared directory, so an interrupted/concurrent write
+# tears an entry, and deserializing a torn executable corrupts the process
+# heap (the PR 1 root cause: mid-suite segfaults, then deterministic crashes
+# at the same test on every later run). Still opt-in via DSTPU_TEST_CACHE,
+# but now SAFE when opted into: utils/compile_cache.py points jax at a
+# per-process staging dir seeded from the shared one and publishes new
+# entries back by atomic rename at exit — concurrent writers (xdist, the
+# two-process e2e workers) can no longer tear what a reader sees.
 _cache_dir = os.environ.get("DSTPU_TEST_CACHE")
 if _cache_dir:
-    jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    from deepspeedsyclsupport_tpu.utils.compile_cache import (
+        enable_safe_persistent_cache)
+
+    enable_safe_persistent_cache(_cache_dir, min_compile_secs=0.5)
 
 import pytest  # noqa: E402
 

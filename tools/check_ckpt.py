@@ -42,6 +42,7 @@ def check_tag(path: str, verbose: bool = False) -> bool:
     ok, reason = verify_tree(path)
     status = "OK " if ok else "BAD"
     print(f"{status} {path}: {reason}")
+    _pod_verdict(path)
     if ok and verbose:
         try:
             with open(os.path.join(path, META_FILE)) as f:
@@ -51,6 +52,32 @@ def check_tag(path: str, verbose: bool = False) -> bool:
         except (OSError, ValueError):
             pass
     return ok
+
+
+def _pod_verdict(path: str) -> None:
+    """Pod-completeness verdict for one tag: did every rank of the saving
+    pod commit (two-phase protocol, ``checkpoint/engine.py::pod_commit``)?
+    ``verify_tree`` already refuses a torn pod; this line tells the
+    operator *which shape* of torn it is and what a complete one covered."""
+    import json as _json
+
+    from deepspeedsyclsupport_tpu.checkpoint.engine import (COMMIT_FILE,
+                                                            pod_complete)
+
+    ok, reason = pod_complete(path)
+    if ok and reason.startswith("ok (pre-pod-commit"):
+        print("    pod: n/a (pre-pod-commit tag, no commit record)")
+        return
+    if ok:
+        try:
+            with open(os.path.join(path, COMMIT_FILE)) as f:
+                world = int(_json.load(f).get("world_size", 1))
+        except (OSError, ValueError):
+            world = 1
+        print(f"    pod: COMPLETE (all {world} rank(s) committed)")
+    else:
+        print(f"    pod: TORN — {reason} (no rank will ever resolve this "
+              f"tag; quarantined at next resume)")
 
 
 def check_save_dir(save_dir: str, verbose: bool = False) -> bool:

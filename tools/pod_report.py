@@ -90,6 +90,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         stream_path = report.source_files.get(rank, "?")
         print(f"note: rank{rank} stream is truncated (salvaged partial "
               f"records from {stream_path})", file=sys.stderr)
+    if report.comm_hang is not None:
+        h = report.comm_hang
+        who = (f"rank{h['culprit_rank']} ({h.get('culprit_reason')})"
+               if h.get("culprit_rank") is not None else "unattributed")
+        print(f"COMM HANG: step {h['step']} — culprit {who}; see the "
+              f"'collective hang' section below", file=sys.stderr)
     print(report.render(last=args.last))
     if args.json:
         with open(args.json, "w") as f:
