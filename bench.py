@@ -838,12 +838,24 @@ def _serving_result(wall, total, evicted, total_decoded, evicted_tokens,
 
 def _drive_serving_sla(eng, prompts, n_clients, reqs_per_client, gen_len,
                        uid_base, arrival_of=None, deadline=None,
-                       ttft_sla=None, rate_sla=None, capacity=None):
+                       ttft_sla=None, rate_sla=None, capacity=None,
+                       journal_dir=None, crash_at_tokens=None):
     """Closed-loop clients over the SLA serving policy layer
     (``inference/v2/serving.ServingSession``) — the third arm next to
     ``_drive_serving``'s naive/splitfuse: admission control (queue/shed),
     slack-ordered batch composition, lowest-slack KV preemption, and fused
     K-step decode whenever every live stream is in steady state.
+
+    ``journal_dir`` + ``crash_at_tokens`` turn the drive into the
+    AVAILABILITY arm: requests are journaled, and once ``crash_at_tokens``
+    total tokens have been emitted the serving replica "dies" mid-decode —
+    KV state, descriptors and all session policy state are dropped; a
+    replacement session on the warm engine replays the journal from each
+    stream's emitted-token watermark and the drive continues. The wall
+    clock keeps running through the failover, so goodput-with-recovery
+    honestly includes the recovery gap. (A warm replacement isolates the
+    REPLAY cost; the cold-start path — process death, restart, compile —
+    is the supervisor e2e's job, ``tests/unit/test_serving_resilience``.)
 
     Returns the same result dict as ``_drive_serving`` plus a ``serve``
     sub-dict (admitted/queued/shed/evicted counters and ``shed_pct``). A
@@ -863,17 +875,23 @@ def _drive_serving_sla(eng, prompts, n_clients, reqs_per_client, gen_len,
     from deepspeedsyclsupport_tpu.inference.v2 import (ServingPolicyConfig,
                                                        ServingSession)
 
+    from deepspeedsyclsupport_tpu.inference.v2.supervisor import journal_path
+
     arrival_of = arrival_of or {}
     have_sla = ttft_sla is not None or bool(rate_sla)
     pol = ServingPolicyConfig(
         admission="sla" if have_sla else "none",
         ttft_sla_s=ttft_sla, token_rate_sla=rate_sla or 0.0,
         shed_policy="queue", preempt_policy="reject",
-        max_queue_s=(4.0 * ttft_sla if ttft_sla else 60.0))
+        max_queue_s=(4.0 * ttft_sla if ttft_sla else 60.0),
+        journal_path=(journal_path(journal_dir, attempt=0)
+                      if journal_dir else None))
     # `capacity` is SHARED across the sweep's arms: the solo calibration
     # run measures real prefill/decode rates into it, so the admission gate
     # at every load point projects from measurements, not priors
     sess = ServingSession(eng, pol, capacity=capacity)
+    crashed = False
+    recovery_summary = None
 
     ttfts, itls = [], []
     submitted, last_tok, gen_count, ttft_of = {}, {}, {}, {}
@@ -955,6 +973,30 @@ def _drive_serving_sla(eng, prompts, n_clients, reqs_per_client, gen_len,
             elif ev.kind == "shed":
                 shed += 1
                 record_done(ev.uid, ev.t, was_evicted=True)
+        if (crash_at_tokens is not None and not crashed
+                and total_decoded >= crash_at_tokens):
+            # ------- injected replica death + journal-replay failover
+            import dataclasses as _dc
+
+            from deepspeedsyclsupport_tpu.inference.v2 import (
+                load_journal, recover_requests)
+
+            crashed = True
+            eng.flush(list(eng.seqs))   # KV state + descriptors lost
+            sess.close()
+            states, last_t = load_journal(journal_dir)
+            sess = ServingSession(
+                eng, _dc.replace(pol, journal_path=journal_path(
+                    journal_dir, attempt=1)),
+                capacity=capacity)
+            recovery_summary = recover_requests(sess, states, last_t)
+            now = time.perf_counter()
+            for uid in recovery_summary["shed"]:
+                # a replay shed is terminal without a session event —
+                # account it as an SLA miss like any other shed
+                shed += 1
+                record_done(uid, now, was_evicted=True)
+            continue
         if events:
             stall_guard = 0
             continue
@@ -981,6 +1023,13 @@ def _drive_serving_sla(eng, prompts, n_clients, reqs_per_client, gen_len,
                     "shed_pct": round(100.0 * shed / max(total, 1), 1),
                     "prefill_tok_s_est": st["prefill_tok_s_est"],
                     "decode_step_s_est": st["decode_step_s_est"]}
+    if recovery_summary is not None:
+        res["serve"]["recovery"] = {
+            "replays": len(recovery_summary["replayed"]),
+            "replay_sheds": len(recovery_summary["shed"]),
+            "time_to_recover_s": recovery_summary["time_to_recover_s"]}
+    if journal_dir is not None:
+        sess.close()
     return res
 
 
@@ -1139,11 +1188,12 @@ def _serve_goodput_once(model_name, platform, *, client_sweep,
                                     **extra})
     rng = np.random.RandomState(0)
 
-    def prompts_for(uid_base, n_clients):
+    def prompts_for(uid_base, n_clients, reqs=None):
         return {uid_base + c * 1000 + r:
                 [int(t) for t in rng.randint(1, cfg.vocab_size - 1,
                                              size=prompt_len)]
-                for c in range(n_clients) for r in range(reqs_per_client)}
+                for c in range(n_clients)
+                for r in range(reqs or reqs_per_client)}
 
     eng.warmup(fused_ladder=True)  # pre-compile every fused-K rung: a tail
     # absorbing < K steps mid-sweep must not pay a compile inside a timed arm
@@ -1263,6 +1313,66 @@ def _serve_goodput_once(model_name, platform, *, client_sweep,
         raise RuntimeError(
             f"serve_goodput: no load point completed inside the sweep "
             f"budget ({sweep_budget_s}s); skipped={skipped}")
+
+    # ------- availability detail: goodput THROUGH a fault. One extra run
+    # of a completed load point with an injected mid-decode replica death
+    # + journal-replay failover (inference/v2/supervisor.py), compared
+    # against the SAME load's fault-free SLA arm from the sweep. The
+    # pre-journal/pre-replay behavior was total loss of every in-flight
+    # stream — the contract here is nonzero goodput through the fault.
+    availability = None
+    if points and (sweep_end is None
+                   or sweep_end - time.perf_counter() > 60):
+        import tempfile
+
+        # lightest COMPLETED point (least fault-free shedding), with
+        # enough requests per client that some are served entirely before
+        # or after the fault — the streams live at the crash instant eat
+        # the recovery gap in their decode rate (an honest SLA miss), so
+        # the surviving goodput comes from the rest
+        n_av = points[0]["clients"]
+        av_reqs = max(3, reqs_per_client)
+        uid_base = 17_000_000
+        arrivals = {uid_base + c * 1000 + 0: c * solo_span / n_av
+                    for c in range(n_av)}
+        crash_tokens = max(8, n_av * av_reqs * gen_len // 4)
+        try:
+            with tempfile.TemporaryDirectory() as jdir:
+                # fault-free arm at the SAME load shape (reqs differ from
+                # the sweep point, so re-measure rather than reuse)
+                ff_r = _drive_serving_sla(
+                    eng, prompts_for(uid_base + 500, n_av, av_reqs),
+                    n_av, av_reqs,
+                    gen_len, uid_base + 500, arrival_of={
+                        uid_base + 500 + c * 1000: c * solo_span / n_av
+                        for c in range(n_av)},
+                    deadline=sweep_end, ttft_sla=ttft_sla,
+                    rate_sla=sla_rate, capacity=capacity)
+                ff_gp, _ = _goodput(ff_r.pop("req_stats"), sla_rate,
+                                    ttft_sla, ff_r["wall_s"])
+                r = _drive_serving_sla(
+                    eng, prompts_for(uid_base, n_av, av_reqs),
+                    n_av, av_reqs,
+                    gen_len, uid_base,
+                    arrival_of=arrivals, deadline=sweep_end,
+                    ttft_sla=ttft_sla, rate_sla=sla_rate,
+                    capacity=capacity, journal_dir=jdir,
+                    crash_at_tokens=crash_tokens)
+            gp, miss = _goodput(r.pop("req_stats"), sla_rate, ttft_sla,
+                                r["wall_s"])
+            availability = {
+                "clients": n_av, "reqs_per_client": av_reqs,
+                "crash_at_tokens": crash_tokens,
+                "goodput_fault_free": round(ff_gp, 2),
+                "goodput_with_recovery": round(gp, 2),
+                "availability_ratio": round(gp / max(ff_gp, 1e-9), 3),
+                "sla_miss_pct": round(100 * miss, 1),
+                "recovery": r["serve"].get("recovery", {}),
+                "baseline": "same-load fault-free SLA arm (availability "
+                            "phase)"}
+        except Exception as e:  # availability is a detail, never the rung
+            availability = {"clients": n_av, "error": str(e)[:200]}
+
     return {
         "metric": f"serve_goodput_sla_{model_name}",
         "value": best[2]["splitfuse"]["goodput_tok_s"],
@@ -1278,6 +1388,7 @@ def _serve_goodput_once(model_name, platform, *, client_sweep,
                    "best_goodput_ratio_splitfuse_vs_naive": round(best[1], 3),
                    "load_sweep": points,
                    "load_points_skipped": skipped,
+                   "availability": availability,
                    "baseline": "SplitFuse-vs-naive goodput ratio at the "
                                "best load point vs the reference FastGen "
                                "2.3x effective-throughput headline"},

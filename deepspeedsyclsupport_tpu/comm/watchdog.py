@@ -48,6 +48,13 @@ from ..utils.logging import logger
 # mirrored by the elastic agent's per-cause restart accounting.
 COMM_HANG_EXIT_CODE = 218
 
+# Distinguished "a serving decode dispatch expired its deadline" exit code
+# (the serving-plane sibling of 218 — `inference/v2/supervisor.py` and the
+# elastic agent count it as its own restart class; docs/serving.md's
+# failure contract). Defined here next to its training-plane twin so the
+# supervisor/agent import stays jax-free.
+SERVE_HANG_EXIT_CODE = 219
+
 
 class CollectiveWatchdog:
     """Deadline watch over one engine's collective phase.
@@ -57,12 +64,22 @@ class CollectiveWatchdog:
     covers them). The hot-path state is a single tuple attribute —
     GIL-atomic to publish, so the poller thread never needs the step path
     to take a lock.
+
+    The deadline/abort machinery is plane-agnostic: the serving layer
+    (``inference/v2/serving.py``) runs the SAME class around its decode
+    dispatches with ``exit_code=SERVE_HANG_EXIT_CODE``, its own resilience
+    counter and ``serve/arm``/``serve/hang`` record names — one watchdog
+    implementation, two structured-exit contracts (rc 218 / rc 219).
     """
 
     def __init__(self, deadline_s: float, warmup_deadline_s: Optional[float]
                  = None, poll_s: float = 0.25, rank: int = 0,
                  telemetry: Any = None, stack_path: Optional[str] = None,
-                 exit_fn: Optional[Callable[[int], None]] = None):
+                 exit_fn: Optional[Callable[[int], None]] = None,
+                 exit_code: int = COMM_HANG_EXIT_CODE,
+                 abort_counter: str = "comm_hang_aborts",
+                 arm_name: str = "comm/arm", hang_name: str = "comm/hang",
+                 what: str = "collective"):
         if deadline_s <= 0:
             raise ValueError(f"watchdog deadline_s must be > 0, "
                              f"got {deadline_s}")
@@ -76,6 +93,11 @@ class CollectiveWatchdog:
         self.telemetry = telemetry
         self.stack_path = stack_path
         self._exit_fn = exit_fn or os._exit
+        self.exit_code = int(exit_code)
+        self.abort_counter = abort_counter
+        self.arm_name = arm_name
+        self.hang_name = hang_name
+        self.what = what
         #: (step, armed_at_monotonic, deadline_s) while a collective phase
         #: is in flight, else None — published with one attribute store
         self._inflight: Optional[Tuple[int, float, float]] = None
@@ -93,7 +115,7 @@ class CollectiveWatchdog:
                    else self.warmup_deadline_s))
         rec = self._recorder()
         if rec is not None:
-            rec.record("event", "comm/arm", step=step,
+            rec.record("event", self.arm_name, step=step,
                        data={"deadline_s": d, "rank": self.rank})
         self._inflight = (int(step), time.monotonic(), d)
         return d
@@ -142,26 +164,27 @@ class CollectiveWatchdog:
         self._fired = True
         from ..monitor.monitor import resilience_counters
 
-        resilience_counters.incr("comm_hang_aborts")
+        resilience_counters.incr(self.abort_counter)
         logger.error(
-            "collective watchdog: step %d in flight %.1fs > deadline %.1fs "
-            "— rank %d declares a comm hang; dumping stacks and exiting "
-            "rc=%d", step, waited, deadline, self.rank, COMM_HANG_EXIT_CODE)
+            "%s watchdog: step %d in flight %.1fs > deadline %.1fs "
+            "— rank %d declares a hang; dumping stacks and exiting "
+            "rc=%d", self.what, step, waited, deadline, self.rank,
+            self.exit_code)
         self._dump_stacks()
         rec = self._recorder()
         if rec is not None:
             try:
-                rec.record("event", "comm/hang", step=step,
+                rec.record("event", self.hang_name, step=step,
                            data={"waited_s": round(waited, 3),
                                  "deadline_s": deadline, "rank": self.rank})
             except Exception:  # pragma: no cover - never block the exit
                 pass
         if self.telemetry is not None:
             try:  # force the ring (arm records included) onto disk
-                self.telemetry.dump("comm_hang")
+                self.telemetry.dump(self.hang_name.replace("/", "_"))
             except Exception as e:  # pragma: no cover
                 logger.warning("watchdog telemetry dump failed: %s", e)
-        self._exit_fn(COMM_HANG_EXIT_CODE)
+        self._exit_fn(self.exit_code)
 
     def _dump_stacks(self) -> None:
         """All-thread faulthandler dump: the main thread is wedged inside a
@@ -171,8 +194,9 @@ class CollectiveWatchdog:
         try:
             if self.stack_path:
                 with open(self.stack_path, "a") as f:
-                    f.write(f"\n=== comm watchdog fired (rank {self.rank}, "
-                            f"pid {os.getpid()}) ===\n")
+                    label = self.arm_name.split("/", 1)[0]  # comm | serve
+                    f.write(f"\n=== {label} watchdog fired "
+                            f"(rank {self.rank}, pid {os.getpid()}) ===\n")
                     f.flush()
                     faulthandler.dump_traceback(file=f, all_threads=True)
             else:

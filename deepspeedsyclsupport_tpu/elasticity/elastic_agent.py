@@ -24,7 +24,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from .elasticity import ElasticityError, compute_elastic_config
-from ..comm.watchdog import COMM_HANG_EXIT_CODE
+from ..comm.watchdog import COMM_HANG_EXIT_CODE, SERVE_HANG_EXIT_CODE
 from ..runtime.resilience import PREEMPTION_EXIT_CODE
 from ..utils.logging import logger
 
@@ -45,6 +45,13 @@ class DSElasticAgent:
       off exponentially — a broken link would hot-loop — but never billed
       against ``restart_limit``: the code didn't crash, the fabric (or one
       host) did.
+    * ``SERVE_HANG_EXIT_CODE`` (219) — the serving plane's stuck-decode
+      watchdog (``inference/v2/serving.py``) declared a wedged decode
+      dispatch: same treatment as 218 (own streak counter
+      ``serve_hang_restarts``, bounded by ``serve_hang_limit``,
+      exponential backoff, never billed to ``restart_limit``) — the
+      restarted replica replays its request journal
+      (``inference/v2/supervisor.py``).
     * any other non-zero rc — a real failure: counted against
       ``restart_limit`` and backed off exponentially
       (``backoff_seconds * 2^failures`` + jitter, capped at
@@ -69,6 +76,7 @@ class DSElasticAgent:
                  backoff_seed: Optional[int] = None,
                  preemption_limit: Optional[int] = None,
                  comm_hang_limit: Optional[int] = None,
+                 serve_hang_limit: Optional[int] = None,
                  storm_limit: Optional[int] = None,
                  nprocs: Optional[int] = None,
                  teardown_grace: float = 5.0,
@@ -94,6 +102,9 @@ class DSElasticAgent:
         # consecutive watchdog comm-hang exits (rc 218) before giving up —
         # a persistently broken interconnect is not self-healing
         self.comm_hang_limit = comm_hang_limit
+        # consecutive stuck-decode exits (rc 219, the serving-plane
+        # watchdog) before giving up — same reasoning as comm hangs
+        self.serve_hang_limit = serve_hang_limit
         # restart-storm cap: TOTAL relaunches of ANY cause (failure,
         # preemption, comm hang). The per-class limits each bound their own
         # streak; this bounds their sum, so alternating causes can't dodge
@@ -122,8 +133,12 @@ class DSElasticAgent:
         self.restart_count = 0  # failures only — preemptions are free
         self.preemption_count = 0
         self.comm_hang_count = 0
+        self.serve_hang_count = 0
         self.teardown_count = 0
         self.launch_history: List[Dict[str, Any]] = []
+        # set by serving-mode subclasses (ReplicaSupervisor's drain path):
+        # stop supervising after the current launch instead of relaunching
+        self._stop_requested = False
 
     def next_backoff(self, consecutive_failures: int) -> float:
         """Capped exponential backoff + jitter for the Nth consecutive
@@ -380,7 +395,8 @@ class DSElasticAgent:
             # (heartbeat-hang kills land here: negative rc, counted by run)
             non_zero = [rc for rc in rcs.values() if rc != 0]
             return 0 if not non_zero else non_zero[0]
-        for cause in (COMM_HANG_EXIT_CODE, PREEMPTION_EXIT_CODE):
+        for cause in (COMM_HANG_EXIT_CODE, SERVE_HANG_EXIT_CODE,
+                      PREEMPTION_EXIT_CODE):
             if cause in fails.values():
                 return cause
         return fails[min(fails)]
@@ -398,6 +414,7 @@ class DSElasticAgent:
         consecutive_failures = 0
         consecutive_preemptions = 0
         consecutive_comm_hangs = 0
+        consecutive_serve_hangs = 0
         while True:
             world = self.discover_world_size()
             if world < self.min_nodes:
@@ -406,13 +423,14 @@ class DSElasticAgent:
             if 0 < self.max_nodes < world:
                 world = self.max_nodes
             attempt = (self.restart_count + self.preemption_count
-                       + self.comm_hang_count)
+                       + self.comm_hang_count + self.serve_hang_count)
             env = dict(os.environ)
             env.update(self.extra_env)
             env.update(self._resolve(world))
             env["DSTPU_ELASTIC_RESTART_COUNT"] = str(self.restart_count)
             env["DSTPU_ELASTIC_PREEMPTION_COUNT"] = str(self.preemption_count)
             env["DSTPU_ELASTIC_COMM_HANG_COUNT"] = str(self.comm_hang_count)
+            env["DSTPU_ELASTIC_SERVE_HANG_COUNT"] = str(self.serve_hang_count)
             # total prior relaunches of any cause: workers use it to rotate
             # rendezvous ports / name per-incarnation artifacts
             env["DSTPU_ELASTIC_ATTEMPT"] = str(attempt)
@@ -424,42 +442,72 @@ class DSElasticAgent:
                 {"world": world, "rc": rc,
                  "restart": self.restart_count,
                  "preempted": rc == PREEMPTION_EXIT_CODE,
-                 "comm_hang": rc == COMM_HANG_EXIT_CODE})
+                 "comm_hang": rc == COMM_HANG_EXIT_CODE,
+                 "serve_hang": rc == SERVE_HANG_EXIT_CODE})
             if rc == 0:
                 return 0
+            if self._stop_requested:
+                # a drain was requested mid-launch (ReplicaSupervisor's
+                # SIGTERM forwarding): supervision ends with this rc —
+                # relaunching a replica the operator asked to stop would
+                # fight the deployment controller
+                logger.info("elastic agent: stop requested — not "
+                            "relaunching (rc=%d)", rc)
+                return rc
             resilience_counters.incr("restarts")
-            if self.storm_limit is not None and \
-                    (self.restart_count + self.preemption_count
-                     + self.comm_hang_count) >= self.storm_limit:
+            total_relaunches = (self.restart_count + self.preemption_count
+                                + self.comm_hang_count
+                                + self.serve_hang_count)
+            if self.storm_limit is not None \
+                    and total_relaunches >= self.storm_limit:
                 logger.error("elastic agent: restart storm — %d total "
                              "relaunches reached storm_limit %d (last "
                              "rc=%d); giving up",
-                             self.restart_count + self.preemption_count
-                             + self.comm_hang_count, self.storm_limit, rc)
+                             total_relaunches, self.storm_limit, rc)
                 return rc
-            if rc == COMM_HANG_EXIT_CODE:
-                # the collective watchdog aborted a hung all-reduce: stacks
-                # and flight recorder are on disk, the checkpoint is whatever
-                # the last pod-complete tag says. Not billed against
-                # restart_limit (the code didn't crash), but backed off
-                # exponentially — a severed link would otherwise hot-loop —
-                # and bounded by its own consecutive limit.
-                self.comm_hang_count += 1
-                consecutive_comm_hangs += 1
+            if rc in (COMM_HANG_EXIT_CODE, SERVE_HANG_EXIT_CODE):
+                # a watchdog abort — collective (218) or serving decode
+                # (219): stacks and the flight recorder / request journal
+                # are on disk; the restart recovers from the last
+                # pod-complete checkpoint / replays journaled streams. Not
+                # billed against restart_limit (the code didn't crash),
+                # but backed off exponentially — a severed link or a
+                # persistently wedging dispatch would otherwise hot-loop —
+                # and bounded by its own per-cause consecutive limit.
                 consecutive_failures = 0
                 consecutive_preemptions = 0
-                resilience_counters.incr("comm_hang_restarts")
-                if self.comm_hang_limit is not None \
-                        and consecutive_comm_hangs > self.comm_hang_limit:
-                    logger.error("elastic agent: %d consecutive comm hangs "
+                if rc == SERVE_HANG_EXIT_CODE:
+                    consecutive_comm_hangs = 0
+                    consecutive_serve_hangs += 1
+                    self.serve_hang_count += 1
+                    streak, limit = (consecutive_serve_hangs,
+                                     self.serve_hang_limit)
+                    what, counter = "serve", "serve_hang_restarts"
+                    resume = ("restarting; the replica will replay its "
+                              "request journal")
+                    msg_what = "stuck-decode hang"
+                else:
+                    consecutive_serve_hangs = 0
+                    consecutive_comm_hangs += 1
+                    self.comm_hang_count += 1
+                    streak, limit = (consecutive_comm_hangs,
+                                     self.comm_hang_limit)
+                    what, counter = "comm", "comm_hang_restarts"
+                    resume = ("restarting from the newest pod-complete "
+                              "checkpoint")
+                    msg_what = "pod comm hang"
+                resilience_counters.incr(counter)
+                if limit is not None and streak > limit:
+                    logger.error("elastic agent: %d consecutive %s hangs "
                                  "exceeds limit %d — giving up",
-                                 consecutive_comm_hangs, self.comm_hang_limit)
+                                 streak, what, limit)
                     return rc
-                delay = self.next_backoff(consecutive_comm_hangs)
-                logger.warning("elastic agent: pod comm hang (rc=%d, hang "
-                               "#%d) — restarting from the newest "
-                               "pod-complete checkpoint in %.2fs",
-                               rc, self.comm_hang_count, delay)
+                delay = self.next_backoff(streak)
+                logger.warning("elastic agent: %s (rc=%d, hang #%d) — "
+                               "%s in %.2fs", msg_what, rc,
+                               self.serve_hang_count
+                               if rc == SERVE_HANG_EXIT_CODE
+                               else self.comm_hang_count, resume, delay)
                 if delay > 0:
                     self._sleep(delay)
                 continue
@@ -473,6 +521,7 @@ class DSElasticAgent:
                 consecutive_preemptions += 1
                 consecutive_failures = 0
                 consecutive_comm_hangs = 0
+                consecutive_serve_hangs = 0
                 if self.preemption_limit is not None \
                         and consecutive_preemptions > self.preemption_limit:
                     logger.error("elastic agent: %d consecutive preemptions "
@@ -492,6 +541,7 @@ class DSElasticAgent:
             consecutive_failures += 1
             consecutive_preemptions = 0
             consecutive_comm_hangs = 0
+            consecutive_serve_hangs = 0
             if self.restart_count > self.restart_limit:
                 logger.error("elastic agent: restart limit %d exhausted "
                              "(last rc=%d)", self.restart_limit,
@@ -526,6 +576,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--comm-hang-limit", type=int, default=None,
                     help="consecutive collective-watchdog exits (rc 218) "
                          "before the agent gives up (default: unbounded)")
+    ap.add_argument("--serve-hang-limit", type=int, default=None,
+                    help="consecutive stuck-decode-watchdog exits (rc 219, "
+                         "the serving plane) before the agent gives up "
+                         "(default: unbounded)")
     ap.add_argument("--storm-limit", type=int, default=None,
                     help="TOTAL relaunches of any cause before the agent "
                          "gives up — the restart-storm cap (default: "
@@ -560,6 +614,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                            backoff_ceiling=args.backoff_ceiling,
                            preemption_limit=args.preemption_limit,
                            comm_hang_limit=args.comm_hang_limit,
+                           serve_hang_limit=args.serve_hang_limit,
                            storm_limit=args.storm_limit,
                            nprocs=args.nprocs,
                            teardown_grace=args.teardown_grace,

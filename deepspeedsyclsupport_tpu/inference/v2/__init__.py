@@ -16,11 +16,18 @@ scheduling:
   can_schedule`` contract (``inference/v2/engine_v2.py:107-237``)
 * :mod:`.serving` — SLA-aware serving policy layer (admission control,
   capacity model, overload-graceful eviction; ``docs/serving.md``)
+* :mod:`.supervisor` — serving-plane fault tolerance: request journal,
+  crash-replay recovery, replica supervisor, rc-219 stuck-decode contract
+  (``docs/serving.md`` "failure contract")
 """
 from .config import RaggedInferenceConfig, ServingPolicyConfig  # noqa: F401
 from .engine_v2 import InferenceEngineV2  # noqa: F401
 from .ragged import BlockedAllocator, RaggedBatch, SequenceDescriptor  # noqa: F401
 from .serving import CapacityModel, ServeEvent, ServingSession  # noqa: F401
+from .supervisor import (RequestJournal, ReplayRequest,  # noqa: F401
+                         ReplicaSupervisor, SERVE_HANG_EXIT_CODE,
+                         load_journal, reconstruct_outputs,
+                         recover_requests)
 
 
 def build_hf_engine(path: str, **config) -> "InferenceEngineV2":

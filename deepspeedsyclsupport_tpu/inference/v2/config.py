@@ -161,6 +161,26 @@ class ServingPolicyConfig:
     decode_step_s_prior: float = 0.05
     # telemetry: emit Serve/* metrics through monitor.telemetry
     telemetry: bool = True
+    # --- fault tolerance (docs/serving.md "failure contract") -----------
+    # request journal: every admitted request's immutable prompt, SLA
+    # fields and emitted-token watermark as a rank-local JSONL (flushed
+    # per record), so in-flight state survives the process and a replica
+    # supervisor can replay from the watermark. None = no journal.
+    journal_path: Optional[str] = None
+    # stuck-decode watchdog: arm a deadline around each scheduling round's
+    # device dispatches; on expiry dump stacks, flush the journal/telemetry
+    # and exit rc 219 (SERVE_HANG_EXIT_CODE) — the serving twin of the
+    # rc-218 collective-hang contract
+    watchdog_enabled: bool = False
+    watchdog_deadline_s: float = 60.0
+    watchdog_warmup_deadline_s: Optional[float] = None  # default 10x: the
+    #   first round compiles (prefill + sampler + fused rungs)
+    watchdog_poll_s: float = 0.25
+    # structured backpressure: consecutive no-progress scheduling rounds
+    # (no events, no dispatches) with live streams before the session
+    # preempts the lowest-slack stream to un-wedge the batch — the KV
+    # exhaustion self-healing valve (never an exception out of step())
+    stall_patience_rounds: int = 3
     extra: Dict[str, Any] = field(default_factory=dict)  # forward-compat bag
 
     def __post_init__(self):
@@ -185,6 +205,20 @@ class ServingPolicyConfig:
         if self.ttft_sla_s is not None and self.ttft_sla_s <= 0:
             raise ValueError(f"ttft_sla_s must be positive, got "
                              f"{self.ttft_sla_s}")
+        if self.watchdog_deadline_s <= 0 or self.watchdog_poll_s <= 0:
+            raise ValueError(
+                f"watchdog deadline_s/poll_s must be > 0, got "
+                f"{self.watchdog_deadline_s}/{self.watchdog_poll_s}")
+        if self.watchdog_warmup_deadline_s is not None \
+                and self.watchdog_warmup_deadline_s < self.watchdog_deadline_s:
+            raise ValueError(
+                f"watchdog_warmup_deadline_s "
+                f"({self.watchdog_warmup_deadline_s}) must be >= "
+                f"watchdog_deadline_s ({self.watchdog_deadline_s}): the "
+                f"first round includes compilation")
+        if self.stall_patience_rounds < 1:
+            raise ValueError(f"stall_patience_rounds must be >= 1, got "
+                             f"{self.stall_patience_rounds}")
 
     @classmethod
     def from_config(cls, config: Optional[Dict] = None, **kw):

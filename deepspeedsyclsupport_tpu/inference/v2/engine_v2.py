@@ -571,7 +571,16 @@ class InferenceEngineV2:
             return None
         for u, w in zip(uids, wants):
             if w:
-                self.seqs[u].blocks.extend(self.allocator.allocate(w))
+                got = self.allocator.try_allocate(w)
+                if got is None:
+                    # pool exhausted under us (injected kv_alloc_fail or
+                    # bookkeeping drift): fall back to the per-token path,
+                    # which evicts under pressure — blocks already handed
+                    # to earlier uids stay owned by their sequences (used
+                    # next append or reclaimed by their flush), so no
+                    # unwinding is needed and nothing raises mid-serve
+                    return None
+                self.seqs[u].blocks.extend(got)
 
         key = (k, sp.structure)
         fn = self._decode_multi.get(key)

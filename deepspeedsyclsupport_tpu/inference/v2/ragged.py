@@ -22,7 +22,16 @@ import numpy as np
 
 
 class BlockedAllocator:
-    """KV block free-list (reference ``ragged/blocked_allocator.py``)."""
+    """KV block free-list (reference ``ragged/blocked_allocator.py``).
+
+    Serving-loop callers (the scheduler's chunk admission, the fused-decode
+    pre-fund) go through :meth:`try_allocate`: exhaustion — real or
+    injected (``DSTPU_FAULT_INJECTION`` ``kv_alloc_fail``) — answers
+    ``None`` so the engine surfaces structured backpressure (the sequence
+    stays pending / falls back to the evicting per-token path) instead of
+    an exception tearing down the whole serving loop. :meth:`allocate`
+    keeps the raising contract for callers that pre-checked.
+    """
 
     def __init__(self, num_blocks: int):
         if num_blocks < 1:
@@ -33,6 +42,20 @@ class BlockedAllocator:
     @property
     def free_blocks(self) -> int:
         return len(self._free)
+
+    def try_allocate(self, n: int) -> Optional[List[int]]:
+        """``allocate`` that reports exhaustion (or an injected allocation
+        fault) as ``None`` instead of raising — the serving engine's
+        backpressure seam."""
+        if n > len(self._free):
+            return None
+        if n > 0:
+            from ...utils.fault_injection import get_fault_injector
+
+            if get_fault_injector().should_fail_kv_alloc():
+                return None
+        out, self._free = self._free[:n], self._free[n:]
+        return out
 
     def allocate(self, n: int) -> List[int]:
         if n > len(self._free):

@@ -185,8 +185,12 @@ def schedule_chunks(seqs: Sequence[SequenceDescriptor],
 def _admit(d: SequenceDescriptor, n: int, allocator: BlockedAllocator,
            block_size: int, max_context: int) -> bool:
     want = d.blocks_needed(n, block_size)
-    if want > allocator.free_blocks:
-        return False
     if want:
-        d.blocks.extend(allocator.allocate(want))
+        # try_allocate: pool exhaustion (or an injected kv_alloc_fail)
+        # skips the chunk this round — structured backpressure, never an
+        # exception out of put()'s scheduling pass
+        got = allocator.try_allocate(want)
+        if got is None:
+            return False
+        d.blocks.extend(got)
     return True

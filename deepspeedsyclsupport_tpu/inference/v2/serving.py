@@ -30,9 +30,10 @@ policy with a synthetic clock and capacity model. See ``docs/serving.md``
 for the overload-behavior contract and config keys.
 """
 import math
+import os
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,14 +43,28 @@ from .config import ServingPolicyConfig
 from .kv_cache import kv_pool_stats
 from .scheduler import SlackPolicy, slack_of
 from ..sampling import SamplingParams
+from ...comm.watchdog import SERVE_HANG_EXIT_CODE, CollectiveWatchdog
+from ...utils.fault_injection import get_fault_injector
+from ...utils.logging import logger
 
 #: ``Serve/*`` metric names this module emits (registered in
 #: ``monitor.telemetry.EVENT_NAMES`` so ``DSTPU_STRICT_EVENTS=1`` passes).
 SERVE_COUNTERS = ("Serve/admitted", "Serve/queued", "Serve/shed",
                   "Serve/evicted", "Serve/completed")
 SERVE_GAUGES = ("Serve/queue_depth", "Serve/kv_occupancy", "Serve/live_seqs")
-SERVE_HISTOGRAMS = ("Serve/ttft_s", "Serve/itl_s")
-SERVE_EVENT_NAMES = SERVE_COUNTERS + SERVE_GAUGES + SERVE_HISTOGRAMS
+SERVE_HISTOGRAMS = ("Serve/ttft_s", "Serve/itl_s",
+                    "Serve/recovery.time_to_recover_s")
+#: crash-replay recovery family (``inference/v2/supervisor.py`` — journal
+#: replay counters + the stuck-decode watchdog's abort count). Full
+#: literals on purpose: the static event-name lint resolves each against
+#: the registry.
+_RECOVERY_COUNTERS = {"replays": "Serve/recovery.replays",
+                      "replay_sheds": "Serve/recovery.replay_sheds"}
+SERVE_RECOVERY = (_RECOVERY_COUNTERS["replays"],
+                  _RECOVERY_COUNTERS["replay_sheds"],
+                  "Serve/recovery.serve_hang_aborts")
+SERVE_EVENT_NAMES = (SERVE_COUNTERS + SERVE_GAUGES + SERVE_HISTOGRAMS
+                     + SERVE_RECOVERY)
 
 
 class Ewma:
@@ -204,7 +219,8 @@ class ServingSession:
                  capacity: Optional[CapacityModel] = None,
                  sampling: Optional[SamplingParams] = None,
                  eos_token_id: Optional[int] = None,
-                 rng: Optional[jax.Array] = None):
+                 rng: Optional[jax.Array] = None,
+                 journal: Any = None, watchdog: Any = None):
         self.eng = engine
         self.policy = policy or ServingPolicyConfig()
         self.clock = clock
@@ -218,8 +234,14 @@ class ServingSession:
         self.counters: Dict[str, int] = {
             "admitted": 0, "queued": 0, "shed": 0, "evicted": 0,
             "completed": 0}
+        #: crash-replay recovery accounting (``Serve/recovery.*`` family)
+        self.recovery_counters: Dict[str, int] = {"replays": 0,
+                                                  "replay_sheds": 0}
         self._pending_tok: Dict[int, int] = {}  # sampled, not yet submitted
         self._last_decode_s: Optional[float] = None
+        self._round = 0            # scheduling rounds (watchdog step label)
+        self._tokens_emitted = 0   # serve_crash fault trigger input
+        self._stall_rounds = 0     # consecutive no-progress rounds
         self._rng = rng if rng is not None else \
             jax.random.PRNGKey(engine.config.seed + 1)
         if self.policy.telemetry:
@@ -228,6 +250,40 @@ class ServingSession:
             self._metrics = _mr
         else:
             self._metrics = None
+        # request journal: in-flight state survives the process (see
+        # docs/serving.md "failure contract"); caller-provided instance
+        # wins over the config path
+        if journal is None and self.policy.journal_path:
+            from .supervisor import RequestJournal
+
+            journal = RequestJournal(self.policy.journal_path)
+        self.journal = journal
+        # stuck-decode watchdog: the collective watchdog's machinery with
+        # the serving contract's names — rc 219, serve_hang_aborts, and
+        # serve/arm-serve/hang deadline records into the journal stream
+        if watchdog is None and self.policy.watchdog_enabled:
+            watchdog = CollectiveWatchdog(
+                deadline_s=self.policy.watchdog_deadline_s,
+                warmup_deadline_s=self.policy.watchdog_warmup_deadline_s,
+                poll_s=self.policy.watchdog_poll_s,
+                telemetry=self.journal,
+                exit_code=SERVE_HANG_EXIT_CODE,
+                abort_counter="serve_hang_aborts",
+                arm_name="serve/arm", hang_name="serve/hang",
+                what="serving decode").start()
+        self.watchdog = watchdog
+
+    def close(self) -> None:
+        """Stop the watchdog poller and close the journal stream.
+        Idempotent; live/queued requests stay journaled as in-flight (the
+        truthful state for a replica being stopped mid-serve)."""
+        if self.watchdog is not None:
+            try:
+                self.watchdog.stop()
+            except Exception:  # teardown must never raise out of serving
+                pass
+        if self.journal is not None:
+            self.journal.close()
 
     # ------------------------------------------------------------- admission
     def submit(self, uid: int, tokens: Sequence[int], max_new_tokens: int,
@@ -261,15 +317,101 @@ class ServingSession:
             # _maintain_queue re-gates in deadline order every round (an
             # urgent arrival still legitimately outranks laxer ones there)
             decision = "queue"
+        if decision == "shed":
+            # terminal at submit: the caller learns synchronously, nothing
+            # is in flight — so nothing to journal
+            self._count("shed")
+            return "shed"
+        if self.journal is not None:
+            # journaled BEFORE any token can be produced: from here the
+            # request is in flight and must survive the process
+            self.journal.admit(uid, req.tokens, req.max_new_tokens,
+                               tenant=req.tenant, rate_sla=req.rate_sla,
+                               ttft_sla_s=ttft)
         if decision == "admit":
             self._activate(req, now)
             return "admitted"
-        if decision == "queue":
+        self.queue.append(req)
+        self._count("queued")
+        return "queued"
+
+    def replay(self, uid: int, tokens: Sequence[int], max_new_tokens: int,
+               *, emitted_tokens: Sequence[int] = (),
+               tenant: str = "default", rate_sla: Optional[float] = None,
+               now: Optional[float] = None) -> str:
+        """Re-admit a journaled in-flight request from its emitted-token
+        watermark after an engine death (``supervisor.recover_requests``).
+
+        The TTFT deadline is burned (the first token — if any — was
+        delivered in a previous incarnation), so the gate re-projects on
+        the **rate SLA only**, exactly like PR 4's requeue path; the
+        context is rebuilt as prompt + emitted prefix at activation, so
+        the stream continues from the watermark with zero duplicate
+        tokens. Returns ``"replayed"`` (re-admitted or queued),
+        ``"shed"`` (provably unmeetable — terminal, counted under
+        ``Serve/recovery.replay_sheds``), or ``"completed"`` (the crash
+        landed between the final emit and the close record — the output
+        was already fully delivered)."""
+        if not tokens:
+            raise ValueError("cannot replay an empty prompt")
+        if uid in self.running or uid in self.eng.seqs \
+                or any(r.uid == uid for r in self.queue):
+            raise ValueError(f"uid {uid} is already being served")
+        now = self.clock() if now is None else now
+        out = [int(t) for t in emitted_tokens]
+        rate = (rate_sla if rate_sla is not None
+                else self.policy.token_rate_sla)
+        if len(out) >= max_new_tokens:
+            # fully delivered before the crash; only the close record was
+            # lost — re-journal the final state (admit carrying the full
+            # prefix, so THIS incarnation's journal is self-contained)
+            # plus the missing close, and the next recovery skips the uid
+            self._count("completed")
+            if self.journal is not None:
+                self.journal.admit(uid, tokens, max_new_tokens,
+                                   tenant=tenant, rate_sla=rate, out=out,
+                                   replayed=True)
+                self.journal.close_request(uid, "done")
+            return "completed"
+        req = _Request(
+            uid=uid, tokens=[int(t) for t in tokens],
+            max_new_tokens=int(max_new_tokens), tenant=tenant,
+            arrival_s=now, deadline_s=None, rate_sla=rate,
+            budget=int(max_new_tokens) - len(out), out=out, queued_s=now)
+        if out:
+            # decode phase: slack scoring and the admission gate must see
+            # the first token as delivered (see _activate's same rule for
+            # requeued streams)
+            req.first_token_s = now
+        # replay gate: rate SLA only, and against the BEST-CASE (idle-
+        # engine) measured rate — the replay set was running together
+        # before the crash, so it is proven placeable; _gate's loaded-EWMA
+        # heuristic would shed every replay after the first one re-fills
+        # the engine. "Provably unmeetable" here means even an idle engine
+        # cannot deliver the rate.
+        decision = "admit" if uid in self.eng.check_schedule(
+            [uid], [req.n_prefill]).admitted else "queue"
+        if self.policy.admission != "none" and req.rate_sla > 0 \
+                and self.capacity.decode_tok_s_best \
+                < self.policy.rate_feasibility_margin * req.rate_sla:
+            decision = "shed"
+        if decision == "shed":
+            self._count("shed")
+            self._count_recovery("replay_sheds")
+            if self.journal is not None:
+                self.journal.close_request(uid, "replay_shed")
+            return "shed"
+        if self.journal is not None:
+            self.journal.admit(uid, req.tokens, req.max_new_tokens,
+                               tenant=tenant, rate_sla=rate, out=out,
+                               replayed=True)
+        self._count_recovery("replays")
+        if decision == "admit" and not self.queue:
+            self._activate(req, now)
+        else:
             self.queue.append(req)
             self._count("queued")
-            return "queued"
-        self._count("shed")
-        return "shed"
+        return "replayed"
 
     def _gate(self, req: _Request, now: float, ahead_tokens: int = 0) -> str:
         """admit | queue | shed for one request against the capacity model
@@ -356,22 +498,87 @@ class ServingSession:
     # -------------------------------------------------------------- stepping
     def step(self, now: Optional[float] = None) -> List[ServeEvent]:
         """One scheduling round; returns the round's event stream (possibly
-        empty — e.g. nothing live and nothing admissible)."""
+        empty — e.g. nothing live and nothing admissible).
+
+        The round's device dispatches run inside an armed stuck-decode
+        watchdog window (``policy.watchdog_enabled``): a dispatch that
+        never returns becomes a faulthandler dump + journal flush +
+        ``os._exit(219)`` — the serving twin of the rc-218 collective-hang
+        contract — instead of a silent forever-hang the supervisor can
+        only guess at."""
         now = self.clock() if now is None else now
+        self._round += 1
+        injector = get_fault_injector()
+        rc = injector.should_serve_crash(self._round, self._tokens_emitted)
+        if rc is not None:
+            # a hard crash by definition: no journal close, no flush — the
+            # per-record journal durability is what recovery rides
+            logger.error("fault injection: serving process crashing "
+                         "mid-decode (round %d, %d tokens emitted, rc=%d)",
+                         self._round, self._tokens_emitted, rc)
+            os._exit(rc)
         events: List[ServeEvent] = []
         self._maintain_queue(now, events)
         self.eng.slack_policy = self._slack_policy(now)
+        # arm only when the round has work: an idle poll (the natural
+        # serving-loop pattern while awaiting the first request) must not
+        # consume the one-shot warmup allowance — the first REAL round
+        # compiles prefill + sampler + fused rungs and needs it
+        wd = self.watchdog if (self.running or self.queue) else None
+        if wd is not None:
+            wd.arm(self._round)
+        dispatches0 = self.eng.host_dispatches
         try:
-            if self._can_fuse():
-                fused = self._fused_round(now, events)
-                if fused:
-                    self._flush_gauges()
-                    return events
-            self._per_token_round(now, events)
+            # decode_wedge lands HERE — after arming, inside the watched
+            # window — so the injected stall is exactly the hang the
+            # watchdog exists to convert into rc 219
+            injector.maybe_wedge_decode(self._round)
+            fused = self._can_fuse() and self._fused_round(now, events)
+            if not fused:
+                self._per_token_round(now, events)
         finally:
+            # disarm in a finally: an exception mid-round must not leave
+            # the deadline live to rc-219 the process during ordinary
+            # error handling (the PR 6 watchdog lesson)
+            if wd is not None:
+                wd.disarm(self._round)
             self.eng.slack_policy = None
+        self._note_progress(events, dispatches0, now)
         self._flush_gauges()
         return events
+
+    def _note_progress(self, events: List[ServeEvent], dispatches0: int,
+                       now: float) -> None:
+        """Structured backpressure valve: a round with live streams that
+        neither emitted an event nor dispatched anything is a wedged batch
+        (KV pool exhausted with the remaining holders un-evictable, an
+        injected ``kv_alloc_fail`` streak, allocator drift). After
+        ``stall_patience_rounds`` such rounds the lowest-slack stream is
+        preempted — requeued or rejected-with-partial-output per
+        ``preempt_policy`` — so the batch un-wedges through the session's
+        own event stream instead of an exception (or a caller's stall
+        guard) killing the serving loop."""
+        if events or self.eng.host_dispatches != dispatches0 \
+                or not self.running:
+            self._stall_rounds = 0
+            return
+        self._stall_rounds += 1
+        if self._stall_rounds < self.policy.stall_patience_rounds:
+            return
+        self._stall_rounds = 0
+        victim = self._eviction_victim(now)
+        if victim is None:
+            # no block-holding stream: fall back to lowest slack outright
+            # (its re-prefill is the cheapest to redo)
+            victim = min(self.running, key=lambda u: (
+                slack_of(self.eng.seqs[u], now, self.capacity.prefill_tok_s,
+                         self.capacity.decode_tok_s)
+                if u in self.eng.seqs else 0.0))
+        logger.warning("serving session: %d no-progress rounds with %d "
+                       "live stream(s) — preempting uid %d to un-wedge "
+                       "the batch", self.policy.stall_patience_rounds,
+                       len(self.running), victim)
+        self._evict(victim, now, events)
 
     def _maintain_queue(self, now: float, events: List[ServeEvent]) -> None:
         """Shed queued requests that aged out or became unmeetable; admit
@@ -404,6 +611,10 @@ class ServingSession:
         completion must see closure for a request they received tokens
         from (one terminal event either way, never both)."""
         self._count("shed")
+        if self.journal is not None:
+            self.journal.close_request(
+                req.uid, "evicted" if req.first_token_s is not None
+                else f"shed:{reason}")
         if req.first_token_s is not None:
             events.append(ServeEvent("finish", req.uid, now,
                                      reason="evicted"))
@@ -581,17 +792,26 @@ class ServingSession:
         if requeue:
             # the emitted prefix is part of the context now — a fresh
             # prefill (tokens + out, rebuilt at activation) must restore
-            # its KV before decode can continue
+            # its KV before decode can continue. Still in flight: no
+            # journal close (a crash here replays it from the watermark)
             req.queued_s = now
             self.queue.append(req)
             self._count("queued")
         else:
+            if self.journal is not None:
+                self.journal.close_request(uid, "evicted")
             events.append(ServeEvent("finish", uid, now, reason="evicted"))
 
     # ------------------------------------------------------------- plumbing
     def _note_emission(self, req: _Request, toks: Sequence[int],
                        t: float) -> None:
         req.out.extend(int(t_) for t_ in toks)
+        self._tokens_emitted += len(toks)
+        if self.journal is not None:
+            # journal-before-release: the watermark is on disk before the
+            # caller sees the tokens (step() returns the events after this),
+            # which is what makes crash replay exactly-once
+            self.journal.emit(req.uid, toks, len(req.out))
         if req.first_token_s is None:
             req.first_token_s = t
             d = self.eng.seqs.get(req.uid)
@@ -611,12 +831,20 @@ class ServingSession:
         if flush:
             self.eng.flush([uid])
         self._count("completed")
+        if self.journal is not None:
+            self.journal.close_request(uid, reason)
         events.append(ServeEvent("finish", uid, now, reason=reason))
 
     def _count(self, name: str, n: int = 1) -> None:
         self.counters[name] = self.counters.get(name, 0) + n
         if self._metrics is not None:
             self._metrics.counter(f"Serve/{name}").incr(n)
+
+    def _count_recovery(self, name: str, n: int = 1) -> None:
+        self.recovery_counters[name] = \
+            self.recovery_counters.get(name, 0) + n
+        if self._metrics is not None:
+            self._metrics.counter(_RECOVERY_COUNTERS[name]).incr(n)
 
     def _observe(self, name: str, value: float) -> None:
         if self._metrics is not None:
@@ -639,7 +867,10 @@ class ServingSession:
 
     def stats(self) -> Dict[str, float]:
         """Counters + instantaneous state, for bench lines and operators."""
-        return {**self.counters, "queue_depth": len(self.queue),
+        return {**self.counters,
+                **{f"recovery_{n}": v
+                   for n, v in self.recovery_counters.items()},
+                "queue_depth": len(self.queue),
                 "live_seqs": len(self.running),
                 "kv_occupancy": round(self._kv_occupancy(), 4),
                 "prefill_tok_s_est": round(self.capacity.prefill_tok_s, 1),
@@ -654,9 +885,15 @@ class ServingSession:
         pod report's skew table actually wants."""
         from ...monitor.telemetry import check_events
 
+        from ...monitor.telemetry import resilience_counters
+
         ev = [(f"Serve/{n}", float(v), step)
               for n, v in self.counters.items()]
-        ev += [("Serve/queue_depth", float(len(self.queue)), step),
+        ev += [(_RECOVERY_COUNTERS[n], float(v), step)
+               for n, v in self.recovery_counters.items()]
+        ev += [("Serve/recovery.serve_hang_aborts",
+                float(resilience_counters.get("serve_hang_aborts")), step),
+               ("Serve/queue_depth", float(len(self.queue)), step),
                ("Serve/live_seqs", float(len(self.running)), step),
                ("Serve/kv_occupancy", self._kv_occupancy(), step)]
         if self._metrics is not None:

@@ -76,7 +76,11 @@ class ResilienceCounters:
              # prompt sibling teardown — per-cause, so operators can tell a
              # flaky interconnect from a preemption storm at a glance
              "pod_commits", "torn_pod_quarantined", "comm_hang_aborts",
-             "comm_hang_restarts", "pod_teardowns")
+             "comm_hang_restarts", "pod_teardowns",
+             # serving-plane fault tolerance (PR 11): the stuck-decode
+             # watchdog's rc-219 aborts and the supervisor's per-cause
+             # restart class for them (inference/v2/supervisor.py)
+             "serve_hang_aborts", "serve_hang_restarts")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -133,8 +137,16 @@ EVENT_NAMES = frozenset(
      # counters, and TTFT/ITL latency histograms
      "Serve/queue_depth", "Serve/kv_occupancy", "Serve/live_seqs",
      "Serve/admitted", "Serve/queued", "Serve/shed", "Serve/evicted",
-     "Serve/completed", "Serve/ttft_s", "Serve/itl_s"}
-    | {f"Serve/{h}/{q}" for h in ("ttft_s", "itl_s")
+     "Serve/completed", "Serve/ttft_s", "Serve/itl_s",
+     # serving-plane recovery (inference/v2/supervisor.py — request
+     # journal replay after an engine crash, stuck-decode rc-219 aborts;
+     # dot-tail convention like Pod/comm_hang.* so the static event-name
+     # lint resolves literals): counters + the time-to-recover histogram
+     "Serve/recovery.replays", "Serve/recovery.replay_sheds",
+     "Serve/recovery.serve_hang_aborts",
+     "Serve/recovery.time_to_recover_s"}
+    | {f"Serve/{h}/{q}" for h in ("ttft_s", "itl_s",
+                                  "recovery.time_to_recover_s")
        for q in ("p50", "p95", "p99")}
     | {f"Resilience/{n}" for n in ResilienceCounters.NAMES})
 

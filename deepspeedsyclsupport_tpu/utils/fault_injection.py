@@ -50,6 +50,33 @@ rank misbehaves deterministically while its siblings stay healthy:
   deletes rank ``drop_rank``'s phase-1 manifest. ``skip`` healthy saves
   pass through first; only the actor ``rank`` performs the teardown (the
   files are shared). Consumed by ``checkpoint/engine.py::save_tree``.
+
+Serving-plane faults (one-shot, consumed by
+``inference/v2/serving.ServingSession`` / the KV block allocator — the
+deterministic levers behind the crash-replay and stuck-decode contracts in
+``docs/serving.md``):
+
+* ``decode_wedge`` — ``{"round": N, "seconds": S}``: the serving session
+  blocks for ``seconds`` (default: until killed) inside scheduling round
+  ``N``'s dispatch window, AFTER the stuck-decode watchdog armed — so the
+  session's own watchdog converts the wedge into rc 219
+  (``SERVE_HANG_EXIT_CODE``).
+* ``serve_crash`` — ``{"round": N}`` or ``{"tokens": N}`` (+ optional
+  ``rc``, default 1): the serving process dies with ``os._exit(rc)`` at
+  the start of scheduling round ``N`` / once ``N`` total tokens have been
+  emitted — a hard mid-decode crash with no cleanup, exercising the
+  request journal + replica-supervisor replay path.
+
+  Both serving faults accept an optional ``attempt`` key: fire only in
+  supervisor incarnation ``DSTPU_ELASTIC_ATTEMPT == attempt`` (the env
+  spec is re-read by every restarted process — without the gate a
+  one-shot fault would re-arm each incarnation and recovery could never
+  complete).
+* ``kv_alloc_fail`` — ``{"count": N}``: the next ``N`` KV block-pool
+  allocations behave as exhausted (``BlockedAllocator.try_allocate``
+  returns None). Exercises the structured-backpressure contract: an
+  allocation failure must queue/shed through the session, never raise out
+  of the engine loop.
 """
 import errno
 import json
@@ -83,6 +110,10 @@ class FaultInjector:
         self.hang_step = dict(spec.get("hang_step") or {})
         self.kill_step = dict(spec.get("kill_step") or {})
         self.tear_pod = dict(spec.get("tear_pod") or {})
+        self.decode_wedge = dict(spec.get("decode_wedge") or {})
+        self.serve_crash = dict(spec.get("serve_crash") or {})
+        self._kv_alloc_fails_left = int(
+            (spec.get("kv_alloc_fail") or {}).get("count", 0))
         self._write_failures_left = int(self.write_fail.get("count", 0))
         self._truncates_left = int(self.truncate.get("count", 1)
                                    if self.truncate else 0)
@@ -92,6 +123,8 @@ class FaultInjector:
         self._preempted = False
         self._hung = False
         self._killed = False
+        self._decode_wedged = False
+        self._serve_crashed = False
         self._lock = threading.Lock()
 
     @classmethod
@@ -108,7 +141,9 @@ class FaultInjector:
     def armed(self) -> bool:
         return bool(self.write_fail or self.truncate or self.async_delay
                     or self.preempt_at_step is not None
-                    or self.hang_step or self.kill_step or self.tear_pod)
+                    or self.hang_step or self.kill_step or self.tear_pod
+                    or self.decode_wedge or self.serve_crash
+                    or self._kv_alloc_fails_left)
 
     # ------------------------------------------------------- injection points
     @staticmethod
@@ -182,16 +217,24 @@ class FaultInjector:
                 return False
             self._hung = True
         seconds = float(self.hang_step.get("seconds", 0) or 0)
-        deadline = (time.monotonic() + seconds) if seconds > 0 else None
         logger.warning("fault injection: rank %d hanging %s step %d's "
                        "collective window (%s)", rank,
                        "inside" if phase == "in" else "before",
                        global_steps,
-                       f"{seconds:.0f}s" if deadline else "until killed")
-        while deadline is None or time.monotonic() < deadline:
-            time.sleep(min(1.0, (deadline - time.monotonic())
-                           if deadline else 1.0))
+                       f"{seconds:.0f}s" if seconds > 0 else "until killed")
+        self._stall(seconds)
         return True
+
+    @staticmethod
+    def _stall(seconds: float) -> None:
+        """Block for ``seconds`` (<= 0: effectively forever — the process
+        is expected to be killed first). The sleep argument is clamped to
+        >= 0: the deadline can elapse between the loop check and the
+        argument computation, and a negative ``time.sleep`` raises."""
+        deadline = (time.monotonic() + seconds) if seconds > 0 else None
+        while deadline is None or time.monotonic() < deadline:
+            time.sleep(max(0.0, min(1.0, (deadline - time.monotonic())
+                                    if deadline else 1.0)))
 
     def should_kill(self, rank: int, global_steps: int) -> Optional[int]:
         """One-shot hard-death request for this rank at a step boundary:
@@ -239,6 +282,73 @@ class FaultInjector:
         logger.warning("fault injection: tore pod checkpoint %s (deleted "
                        "%s)", path, os.path.basename(victim))
         return victim
+
+    # ----------------------------------------------------- serving-plane faults
+    @staticmethod
+    def _attempt_matches(spec: Dict[str, Any]) -> bool:
+        """Optional ``attempt`` key: the fault fires only in the named
+        supervisor incarnation (``DSTPU_ELASTIC_ATTEMPT``). The env spec is
+        re-read by every restarted process, so without this gate a one-shot
+        serving fault would re-arm in each incarnation and the recovery it
+        exists to test could never complete."""
+        a = spec.get("attempt")
+        if a is None:
+            return True
+        return int(os.environ.get("DSTPU_ELASTIC_ATTEMPT", "0")) == int(a)
+
+    def maybe_wedge_decode(self, round_no: int) -> bool:
+        """One-shot stall inside the serving session's dispatch window
+        (AFTER the stuck-decode watchdog armed, so rc 219 is the expected
+        outcome). Blocks for ``seconds`` (default: effectively forever —
+        the watchdog or the supervisor is expected to kill the process
+        first). Returns whether it wedged."""
+        with self._lock:
+            if self._decode_wedged or not self.decode_wedge:
+                return False
+            if not self._attempt_matches(self.decode_wedge):
+                return False
+            if round_no < int(self.decode_wedge.get("round", 0)):
+                return False
+            self._decode_wedged = True
+        seconds = float(self.decode_wedge.get("seconds", 0) or 0)
+        logger.warning("fault injection: wedging serving round %d's decode "
+                       "dispatch (%s)", round_no,
+                       f"{seconds:.0f}s" if seconds > 0 else "until killed")
+        self._stall(seconds)
+        return True
+
+    def should_serve_crash(self, round_no: int,
+                           tokens_emitted: int) -> Optional[int]:
+        """One-shot mid-decode hard-death request for the serving process:
+        returns the exit code to die with (the caller ``os._exit``\\ s — no
+        cleanup, no journal close; the request journal's per-record flush
+        is what recovery rides). Triggers at scheduling round ``round`` or
+        once ``tokens`` total tokens have been emitted."""
+        with self._lock:
+            if self._serve_crashed or not self.serve_crash:
+                return None
+            if not self._attempt_matches(self.serve_crash):
+                return None
+            at_round = self.serve_crash.get("round")
+            at_tokens = self.serve_crash.get("tokens")
+            hit = ((at_round is not None and round_no >= int(at_round))
+                   or (at_tokens is not None
+                       and tokens_emitted >= int(at_tokens)))
+            if not hit:
+                return None
+            self._serve_crashed = True
+        return int(self.serve_crash.get("rc", 1))
+
+    def should_fail_kv_alloc(self) -> bool:
+        """Consume one injected KV-pool allocation failure (the allocator
+        reports exhaustion instead of handing out blocks)."""
+        with self._lock:
+            if self._kv_alloc_fails_left <= 0:
+                return False
+            self._kv_alloc_fails_left -= 1
+        logger.warning("fault injection: KV block allocation reported as "
+                       "exhausted")
+        return True
 
 
 # -------------------------------------------------------------- global access

@@ -547,6 +547,7 @@ class TestHistogramQuantiles:
         session = object.__new__(sv.ServingSession)
         session._metrics = reg
         session.counters = {}
+        session.recovery_counters = {}
         session.queue = []
         session.running = {}
         session._kv_occupancy = lambda: 0.0

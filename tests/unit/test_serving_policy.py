@@ -653,6 +653,39 @@ class TestServeTelemetry:
         # strict mode accepts every Serve/* name this layer emits
         check_events([(n, 1.0, 0) for n in SERVE_EVENT_NAMES])
 
+    def test_recovery_family_registered_and_emitted(self, tiny, monkeypatch):
+        """``Serve/recovery.*`` strict-registry family: counters and the
+        time-to-recover histogram (p50/p95/p99 quantile events) are
+        declared, fed by replay, and emitted by ``summary_events`` under
+        strict mode."""
+        from deepspeedsyclsupport_tpu.monitor.telemetry import (
+            EVENT_NAMES, metrics_registry)
+
+        monkeypatch.setenv("DSTPU_STRICT_EVENTS", "1")
+        expected = {"Serve/recovery.replays", "Serve/recovery.replay_sheds",
+                    "Serve/recovery.serve_hang_aborts",
+                    "Serve/recovery.time_to_recover_s"}
+        expected |= {f"Serve/recovery.time_to_recover_s/{q}"
+                     for q in ("p50", "p95", "p99")}
+        assert expected <= EVENT_NAMES
+        model, params = tiny
+        eng = _v2(model, params)
+        sess = ServingSession(eng, ServingPolicyConfig())
+        base = metrics_registry.counter("Serve/recovery.replays").value
+        assert sess.replay(41, [7, 3, 11], 3) == "replayed"
+        _drain(sess)
+        assert metrics_registry.counter(
+            "Serve/recovery.replays").value == base + 1
+        metrics_registry.histogram(
+            "Serve/recovery.time_to_recover_s").observe(1.5)
+        ev = sess.summary_events(step=2)  # validates under strict mode
+        names = {n for n, _v, _s in ev}
+        assert {"Serve/recovery.replays", "Serve/recovery.replay_sheds",
+                "Serve/recovery.serve_hang_aborts",
+                "Serve/recovery.time_to_recover_s/p50"} <= names
+        by_name = {n: v for n, v, _s in ev}
+        assert by_name["Serve/recovery.replays"] >= 1.0
+
     def test_session_feeds_metrics_registry(self, tiny, monkeypatch):
         from deepspeedsyclsupport_tpu.monitor.telemetry import \
             metrics_registry

@@ -150,6 +150,81 @@ def events_summary(records: List[Dict[str, Any]]) -> List[str]:
     return lines
 
 
+def serve_recovery_summary(records: List[Dict[str, Any]]) -> List[str]:
+    """``Serve/recovery.*`` view: journal lifecycle counts (a request
+    journal IS a flight-recorder stream, so this tool reads it directly),
+    stuck-decode watchdog arms/hangs, and the recovery counters +
+    time-to-recover quantiles from metric records / dump snapshots."""
+    admits = [r for r in records if r.get("name") == "serve/admit"]
+    if not admits and not any(
+            str(r.get("name", "")).startswith(
+                "Serve/recovery.")  # dslint: allow(undeclared-event-name) read-side filter
+            for r in records) and not any(
+            r.get("kind") == "dump" and any(
+                k.startswith("Serve/recovery.")  # dslint: allow(undeclared-event-name) read-side filter
+                for k in ((r.get("data") or {}).get("metrics", {})
+                          .get("counters", {})))
+            for r in records):
+        return []
+    lines = ["serving recovery (Serve/recovery.* + request journal)"]
+    if admits:
+        uids = {(r.get("data") or {}).get("uid") for r in admits}
+        replayed = {(r.get("data") or {}).get("uid") for r in admits
+                    if (r.get("data") or {}).get("replayed")}
+        closed = {(r.get("data") or {}).get("uid"): (r.get("data") or {})
+                  .get("reason", "?") for r in records
+                  if r.get("name") == "serve/close"}
+        emitted = sum(len((r.get("data") or {}).get("tokens", []))
+                      for r in records if r.get("name") == "serve/emit")
+        lines.append(f"  journal: {len(uids)} request(s), "
+                     f"{len(replayed)} replayed admit(s), "
+                     f"{len(closed)} closed, "
+                     f"{len(uids) - len(closed)} in flight, "
+                     f"{emitted} token(s) emitted")
+        reasons: Dict[str, int] = {}
+        for reason in closed.values():
+            reasons[reason] = reasons.get(reason, 0) + 1
+        if reasons:
+            lines.append(f"  close reasons: "
+                         + ", ".join(f"{k}={v}"
+                                     for k, v in sorted(reasons.items())))
+    hangs = [r for r in records if r.get("name") == "serve/hang"]
+    for r in hangs:
+        d = r.get("data") or {}
+        lines.append(f"  stuck-decode hang: round {r.get('step', '?')} "
+                     f"waited {d.get('waited_s', '?')}s > deadline "
+                     f"{d.get('deadline_s', '?')}s (rc 219)")
+    # latest scalar values: metric records win; else the last dump marker's
+    # registry snapshot
+    latest: Dict[str, Any] = {}
+    hist = None
+    for r in records:
+        if r.get("kind") == "metric" and \
+                str(r.get("name", "")).startswith(
+                    "Serve/recovery."):  # dslint: allow(undeclared-event-name) read-side filter
+            latest[r["name"]] = r.get("value")
+        if r.get("kind") == "dump":
+            metrics = (r.get("data") or {}).get("metrics", {})
+            for k, v in metrics.get("counters", {}).items():
+                if k.startswith("Serve/recovery."):  # dslint: allow(undeclared-event-name) read-side filter
+                    latest[k] = v
+            h = metrics.get("histograms", {}).get(
+                "Serve/recovery.time_to_recover_s")
+            if h and h.get("count"):
+                hist = h
+    for name in sorted(latest):
+        lines.append(f"  {name} = {latest[name]}")
+    if hist:
+        qs = {q: _pod.histogram_quantile(tuple(hist["buckets"]),
+                                         hist["counts"], hist["count"], q)
+              for q in (0.5, 0.95, 0.99)}
+        qtxt = ", ".join(f"p{int(q * 100)}={v:.3f}s"
+                         for q, v in qs.items() if v is not None)
+        lines.append(f"  time_to_recover ({hist['count']} sample(s)): "
+                     f"{qtxt}")
+    return lines
+
+
 def straggler_summary(per_rank: Dict[int, List[Dict[str, Any]]]) -> List[str]:
     """``per_rank`` is keyed by rank id (inferred by :func:`render` from
     filenames / stream metadata — callers no longer hand-build the dict)."""
@@ -202,6 +277,11 @@ def render(paths: List[str], last: int = 20) -> Optional[str]:
     out.extend(goodput_summary(first))
     out.append("")
     out.extend(events_summary(first))
+    all_records = [r for recs in per_rank.values() for r in recs]
+    recovery = serve_recovery_summary(all_records)
+    if recovery:
+        out.append("")
+        out.extend(recovery)
     if len(per_rank) > 1:
         out.append("")
         out.extend(straggler_summary(per_rank))
