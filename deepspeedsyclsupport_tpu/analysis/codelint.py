@@ -69,7 +69,7 @@ def _suppressed(source_lines: Sequence[str], lineno: int, rule: str) -> bool:
 STEP_PATH_MODULES = (
     "runtime/engine.py", "runtime/zero.py", "runtime/zeropp.py",
     "runtime/onebit.py", "runtime/loss_scaler.py",
-    "runtime/multihost_offload.py",
+    "runtime/multihost_offload.py", "runtime/offload_pipeline.py",
     "comm/comm.py", "comm/comms_logging.py",
     "parallel/", "inference/v2/", "moe/",
     "utils/timer.py", "monitor/telemetry.py",
@@ -77,7 +77,9 @@ STEP_PATH_MODULES = (
 )
 
 #: functions sanctioned to host-sync: print boundaries, checkpoint/telemetry
-#: sites, offline accessors. module-relative "ClassName.method" or "func".
+#: sites, offline accessors, and the offload pipeline's single designated
+#: wait points (every other pull must ride the async-issue/delayed-wait
+#: seam). module-relative "ClassName.method" or "func".
 HOST_SYNC_SANCTIONED = {
     "runtime/engine.py": {
         "Engine._post_step", "Engine._flush_monitor", "Engine.get_lr",
@@ -87,6 +89,14 @@ HOST_SYNC_SANCTIONED = {
         "Engine.xla_comms_summary", "Engine.state_dict", "Engine.eval_batch",
         "Engine.save_16bit_model",
     },
+    # the offload seam: init/restore materialization (once per run) and
+    # the pipeline's designated delayed-wait points — a bare
+    # np.asarray(shard.data) anywhere else in the step path is exactly the
+    # serial pull the bucketed pipeline replaced
+    "runtime/multihost_offload.py": {
+        "MultiHostCPUAdam.__init__", "MultiHostCPUAdam.load_state.pull",
+    },
+    "runtime/offload_pipeline.py": {"ShardPull.wait"},
     "comm/comm.py": {"barrier"},
     "elasticity/elastic_agent.py": set(),
 }
@@ -277,11 +287,26 @@ class WallClockInStepPath(Rule):
 
 class HostSyncInStepPath(Rule):
     name = "host-sync-in-step-path"
-    description = ("block_until_ready/device_get outside sanctioned "
-                   "checkpoint/telemetry/print-boundary sites stalls the "
+    description = ("block_until_ready/device_get — and blocking per-shard "
+                   "np.asarray(shard.data) pulls — outside sanctioned "
+                   "checkpoint/telemetry/offload-seam sites stall the "
                    "dispatch pipeline")
 
     SYNC_CALLS = ("block_until_ready", "device_get")
+    #: np.asarray / np.array over a ``<expr>.data`` attribute is the
+    #: blocking per-shard D2H pull (``shard.data`` is a single-device jax
+    #: array; materializing it synchronously serializes host dispatch
+    #: against the transfer). The sanctioned spelling is an async
+    #: ``jax.device_put`` to the host backend with a delayed wait —
+    #: ``runtime/offload_pipeline.py ShardPull``.
+    PULL_FNS = ("asarray", "array")
+
+    def _is_shard_pull(self, node: ast.Call) -> bool:
+        name = _call_name(node)
+        if name.split(".")[-1] not in self.PULL_FNS:
+            return False
+        return bool(node.args) and isinstance(node.args[0], ast.Attribute) \
+            and node.args[0].attr == "data"
 
     def check(self, relpath, tree, source_lines):
         if not _in_step_path(relpath):
@@ -296,18 +321,25 @@ class HostSyncInStepPath(Rule):
         class V(_ScopedVisitor):
             def visit_Call(self, node):
                 name = _call_name(node)
-                if any(name.endswith(c) for c in rule.SYNC_CALLS):
+                is_sync = any(name.endswith(c) for c in rule.SYNC_CALLS)
+                is_pull = not is_sync and rule._is_shard_pull(node)
+                if is_sync or is_pull:
                     qn = _qualname(self.stack)
                     if qn not in sanctioned and not _suppressed(
                             source_lines, node.lineno, rule.name):
                         snippet = source_lines[node.lineno - 1].strip() \
                             if node.lineno <= len(source_lines) else ""
+                        msg = (f"host sync {name}() in step-path function "
+                               f"{qn!r}; move it to a print boundary / "
+                               f"checkpoint site or suppress with a reason"
+                               if is_sync else
+                               f"blocking per-shard pull {name}(….data) in "
+                               f"step-path function {qn!r}; issue an async "
+                               f"jax.device_put to the host backend with a "
+                               f"delayed wait (offload_pipeline.ShardPull) "
+                               f"or suppress with a reason")
                         violations.append(Violation(
-                            rule.name, relpath, node.lineno,
-                            f"host sync {name}() in step-path function "
-                            f"{qn!r}; move it to a print boundary / "
-                            f"checkpoint site or suppress with a reason",
-                            snippet))
+                            rule.name, relpath, node.lineno, msg, snippet))
                 self.generic_visit(node)
 
         V().visit(tree)

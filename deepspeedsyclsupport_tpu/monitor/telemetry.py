@@ -122,8 +122,16 @@ EVENT_NAMES = frozenset(
     {"Train/Samples/train_loss", "Train/Samples/lr",
      "Train/Samples/loss_scale",
      "Goodput/productive_s", "Goodput/checkpoint_s", "Goodput/compile_s",
-     "Goodput/startup_s", "Goodput/other_s", "Goodput/total_s",
-     "Goodput/productive_frac",
+     "Goodput/offload_stall_s", "Goodput/startup_s", "Goodput/other_s",
+     "Goodput/total_s", "Goodput/productive_frac",
+     # hierarchical offload pipeline (runtime/multihost_offload.py +
+     # offload_pipeline.py; docs/offload.md): per-direction bytes and
+     # effective bandwidth, host fp32-Adam seconds, exposed transfer
+     # stall, and the derived overlap efficiency (1 − exposed/total)
+     "Offload/d2h_bytes", "Offload/h2d_bytes", "Offload/nvme_read_bytes",
+     "Offload/nvme_write_bytes", "Offload/d2h_gbps", "Offload/h2d_gbps",
+     "Offload/nvme_read_gbps", "Offload/host_compute_s", "Offload/stall_s",
+     "Offload/overlap_efficiency",
      "Memory/bytes_in_use", "Memory/peak_bytes_in_use",
      "Compile/count", "Compile/total_s",
      "Ckpt/save_s", "Ckpt/bytes_written",
@@ -550,9 +558,13 @@ class GoodputAccounter:
 
     ``other`` is the residual (total − sum of known categories), so the
     split accounts for 100% of measured wall-clock by construction — the
-    report tool asserts ≥99% survives serialization/rounding."""
+    report tool asserts ≥99% survives serialization/rounding.
+    ``offload_stall`` is the exposed (non-overlapped) transfer wait inside
+    offloaded steps — carved OUT of productive, because a step blocked on
+    D2H/NVMe is exactly the time the offload pipeline exists to hide."""
 
-    CATEGORIES = ("productive", "checkpoint", "compile", "startup", "other")
+    CATEGORIES = ("productive", "checkpoint", "compile", "offload_stall",
+                  "startup", "other")
 
     def __init__(self, clock: Callable[[], float] = time.monotonic):
         self._clock = clock
@@ -775,6 +787,9 @@ class Telemetry:
         self._last_memory_step = -1
         self._last_step_end: Optional[float] = None
         self._step_hist = self.registry.histogram("step_time_s")
+        # run-cumulative offload pipeline ledger (record_offload); the
+        # Offload/* periodic events derive effective bandwidths from it
+        self._offload_totals: Dict[str, float] = {}
         # latest anchor epoch THIS telemetry stamped on its step spans; the
         # counter behind it is process-global (_next_anchor_seq) so two
         # anchored engines in one process get distinct epochs
@@ -808,7 +823,8 @@ class Telemetry:
 
     # ------------------------------------------------------------- step path
     def on_step_end(self, step: int, dur: Optional[float] = None,
-                    batch: Any = None) -> None:
+                    batch: Any = None,
+                    offload: Optional[Dict[str, Any]] = None) -> None:
         """Per-step accounting: step span into the ring, duration histogram,
         recompile attribution (with arg-shape diff), goodput, heartbeat and
         periodic memory gauges.
@@ -855,13 +871,25 @@ class Telemetry:
         self.recorder.record("span", "step", step=step, dur=dur,
                              data=span_data)
         self._step_hist.observe(dur)
+        stall = 0.0
+        if offload:
+            self.record_offload(step, offload)
+            stall = float(offload.get("stall_s", 0.0))
         if self.goodput is not None:
             # account this step BEFORE marking first-step: startup is the
             # residual of everything before it, so the first step's own
             # compile/compute must already be in their buckets or it would
             # be double-counted into startup
-            self.goodput.account("compile", min(d_seconds, dur))
-            self.goodput.account("productive", max(0.0, dur - d_seconds))
+            compile_s = min(d_seconds, dur)
+            self.goodput.account("compile", compile_s)
+            # exposed offload stall is carved OUT of productive (clamped so
+            # timing noise can't push productive negative — accounting
+            # still sums to 100% by construction)
+            stall_s = min(stall, max(0.0, dur - compile_s))
+            if stall_s > 0:
+                self.goodput.account("offload_stall", stall_s)
+            self.goodput.account("productive",
+                                 max(0.0, dur - compile_s - stall_s))
             self.goodput.mark_first_step()
         if self.heartbeat is not None:
             self.heartbeat.beat(step)
@@ -954,6 +982,56 @@ class Telemetry:
                                    "synced": synced})
         return seq
 
+    def record_offload(self, step: int, stats: Dict[str, Any]) -> None:
+        """Persist one offloaded step's transfer/compute ledger
+        (``runtime/offload_pipeline.py`` ``OffloadStats.as_dict()`` shape)
+        as an ``offload/step`` record, feed the byte counters, and
+        accumulate the run totals behind the ``Offload/*`` periodic
+        events. ``tools/trace_report.py`` renders the records offline."""
+        self.recorder.record("event", "offload/step", step=step,
+                             data=dict(stats))
+        for key in ("d2h_bytes", "h2d_bytes", "nvme_read_bytes",
+                    "nvme_write_bytes"):
+            n = int(stats.get(key, 0) or 0)
+            if n:
+                self.registry.counter(f"offload_{key}").incr(n)
+        t = self._offload_totals
+        for key in ("d2h_bytes", "h2d_bytes", "nvme_read_bytes",
+                    "nvme_write_bytes", "d2h_s", "h2d_s", "nvme_read_s",
+                    "host_compute_s", "stall_s", "transfer_s"):
+            t[key] = t.get(key, 0.0) + float(stats.get(key, 0.0) or 0.0)
+
+    def offload_events(self, step: int) -> List[Event]:
+        """``Offload/*`` scalar events from the cumulative ledger: bytes
+        and effective GB/s per direction (bytes over transfer occupancy —
+        conservative, since occupancy spans include overlapped compute),
+        host-compute and exposed-stall seconds, and overlap efficiency."""
+        t = self._offload_totals
+        if not t:
+            return []
+        ev: List[Event] = []
+        for direction in ("d2h", "h2d", "nvme_read"):
+            nbytes = t.get(f"{direction}_bytes", 0.0)
+            secs = t.get(f"{direction}_s", 0.0)
+            ev.append((f"Offload/{direction}_bytes", nbytes, step))
+            if secs > 0:
+                ev.append((f"Offload/{direction}_gbps",
+                           nbytes / 1e9 / secs, step))
+        ev.append(("Offload/nvme_write_bytes",
+                   t.get("nvme_write_bytes", 0.0), step))
+        ev.append(("Offload/host_compute_s",
+                   t.get("host_compute_s", 0.0), step))
+        ev.append(("Offload/stall_s", t.get("stall_s", 0.0), step))
+        if t.get("transfer_s", 0.0) > 0:
+            # canonical definition lives in runtime/offload_pipeline.py
+            # (imported lazily — monitor must stay import-light)
+            from ..runtime.offload_pipeline import overlap_efficiency
+
+            ev.append(("Offload/overlap_efficiency",
+                       overlap_efficiency(t.get("stall_s", 0.0),
+                                          t["transfer_s"]), step))
+        return ev
+
     def record_census(self, census: Dict[str, Any]) -> None:
         """Persist a static collective-census class summary
         (``analysis/collectives.py`` ``CollectiveClasses.summary()`` shape,
@@ -1010,6 +1088,7 @@ class Telemetry:
         commit_hist = snap["histograms"].get("ckpt_pod_commit_s")
         if commit_hist and commit_hist["count"]:
             ev.append(("Ckpt/pod_commit_s", commit_hist["sum"], step))
+        ev.extend(self.offload_events(step))
         return ev
 
     def dump(self, reason: str = "manual") -> List[Dict[str, Any]]:

@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, Optional, Tuple
 
 from . import constants as C
+from .offload_pipeline import DEFAULT_BUCKET_BYTES
 from ..utils.logging import logger
 
 AUTO = "auto"
@@ -107,16 +108,42 @@ class Bf16Config:
 class OffloadConfig:
     """``zero_optimization.offload_{optimizer,param}`` (reference:
     ``runtime/zero/offload_config.py``). ``device`` 'cpu' = host RAM via
-    jax.device_put to the host backend; 'nvme' = async file swap (csrc/aio analog)."""
+    jax.device_put to the host backend; 'nvme' = async file swap (csrc/aio analog).
+
+    Pipeline knobs (``runtime/offload_pipeline.py`` — see docs/offload.md):
+    ``pipeline`` routes Adam-family offload through the bucketed D2H /
+    host-Adam / H2D pipeline (reference ``offload_config.py`` carries the
+    same flag name for its overlapped swap path); ``bucket_size`` is the
+    size-targeted transfer/compute unit in bytes (small leaves coalesce);
+    ``buffer_count`` is the NVMe moment-window depth in buckets (the
+    reference's aio buffer_count — host RAM for moments is bounded by this
+    window, not the store); ``overlap`` off runs identical math inline
+    (the bit-parity debug arm)."""
     device: str = "none"  # none | cpu | nvme
     nvme_path: Optional[str] = None
     pin_memory: bool = True
+    pipeline: bool = True
+    bucket_size: int = DEFAULT_BUCKET_BYTES
+    buffer_count: int = 2
+    overlap: bool = True
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "OffloadConfig":
+        bucket = int(d.get("bucket_size", DEFAULT_BUCKET_BYTES))
+        buffers = int(d.get("buffer_count", 2))
+        if bucket <= 0:
+            raise ValueError(
+                f"offload bucket_size must be > 0 bytes, got {bucket}")
+        if buffers < 1:
+            raise ValueError(
+                f"offload buffer_count must be >= 1, got {buffers}")
         return cls(device=str(d.get("device", "none")),
                    nvme_path=d.get("nvme_path"),
-                   pin_memory=bool(d.get("pin_memory", True)))
+                   pin_memory=bool(d.get("pin_memory", True)),
+                   pipeline=bool(d.get("pipeline", True)),
+                   bucket_size=bucket,
+                   buffer_count=buffers,
+                   overlap=bool(d.get("overlap", True)))
 
     @property
     def enabled(self) -> bool:

@@ -506,13 +506,27 @@ class Engine:
     # ================================================================ offload
     def _init_offload(self, params, tx, off_opt, off_par):
         """Host-resident fp32 master + moments; compute-dtype device params."""
-        if self._multihost:
+        pipe_cfg = off_opt if off_opt.enabled else off_par
+        t = self.config.optimizer.type.lower().replace("_", "")
+        adam_like = t in ("adam", "adamw", "fusedadam", "cpuadam")
+        if not self._multihost and pipe_cfg.pipeline and not adam_like:
+            # the pipelined host engine is a CPU Adam (the reference's
+            # CPUAdam is likewise the only offload optimizer); other optax
+            # optimizers keep the legacy jitted host path below
+            log_dist(f"offload pipeline needs an Adam-family optimizer "
+                     f"(got {self.config.optimizer.type!r}); using the "
+                     f"jitted host-apply path")
+        if self._multihost or (pipe_cfg.pipeline and adam_like):
+            # Bucketed D2H / host-Adam / H2D pipeline with the bounded
+            # NVMe moment window (runtime/multihost_offload.py +
+            # offload_pipeline.py). Topology-agnostic: with one controller
+            # the grad-norm allreduce degenerates to identity and the same
+            # engine serves single-host ZeRO-Offload.
             from .multihost_offload import MultiHostCPUAdam
             from .optimizers import _common
 
             opt_params = self.config.optimizer.params
             _, betas, eps, wd = _common(opt_params)
-            t = self.config.optimizer.type.lower().replace("_", "")
             # mirror build_optimizer: plain "adam" with adam_w_mode=False is
             # optax.adam — no weight decay at all
             if t == "adam" and not opt_params.get("adam_w_mode", True):
@@ -533,14 +547,24 @@ class Engine:
                 mh_swapper = AsyncTensorSwapper(os.path.join(
                     nvme_path, f"rank{jax.process_index()}"))
             self._mh_offload = MultiHostCPUAdam(
-                params, self.grad_shardings, betas=betas, eps=eps,
+                params,
+                # shard layout: the ZeRO-3 grad layout when fsdp shards
+                # exist, else the working-param layout (single controller /
+                # fsdp=1 — every shard is host-addressable either way)
+                self.grad_shardings if self.grad_shardings is not None
+                else self.param_shardings,
+                betas=betas, eps=eps,
                 weight_decay=wd,
                 clip=self.config.gradient_clipping,
                 lr_fn=lambda step: float(np.asarray(
                     self.lr_schedule(step)
                     if callable(self.lr_schedule) else self.lr_schedule)),
                 fp16_cfg=fp16, fp16_enabled=self.fp16_enabled,
-                swapper=mh_swapper)
+                swapper=mh_swapper,
+                bucket_bytes=pipe_cfg.bucket_size,
+                window_buckets=pipe_cfg.buffer_count,
+                overlap=pipe_cfg.overlap,
+                push_dtype=jnp.dtype(self.compute_dtype))
             # the host CPU Adam runs the loss-scale state machine on host
             # (host_update_loss_scale): keep the state numpy-resident so
             # its per-step scale read is a plain float, never a device sync
@@ -716,6 +740,11 @@ class Engine:
             new_master, self.scaler_state, m2 = self._mh_offload.step(
                 grads, self.scaler_state)
             self.params = self._mh_push(new_master)
+            # per-step transfer/stall ledger for telemetry (picked up by
+            # on_step_end → Offload/* events + the goodput offload_stall
+            # bucket); stash-and-pop so an eval between steps can't
+            # double-report it
+            self._last_offload_stats = self._mh_offload.last_stats
             return m2
         if self._host_apply is None:
             self._host_apply = self._build_host_apply_fn()
@@ -1013,7 +1042,8 @@ class Engine:
             # periodic HBM gauges — a few host dict appends (<5% guarded by
             # tests/unit/test_telemetry.py::test_telemetry_overhead)
             self.telemetry.on_step_end(self.global_steps, step_dur,
-                                       batch=batch)
+                                       batch=batch,
+                                       offload=self._pop_offload_stats())
         if self._tracing and self._trace_origin == "config":
             start = int(self._trace_cfg.get("start_step", 1))
             n = int(self._trace_cfg.get("num_steps", 3))
@@ -1265,7 +1295,8 @@ class Engine:
             self.global_steps += 1
             if self.telemetry is not None:
                 # eager-path step span: boundary-to-boundary wall (dur=None)
-                self.telemetry.on_step_end(self.global_steps)
+                self.telemetry.on_step_end(
+                    self.global_steps, offload=self._pop_offload_stats())
             self._post_step(metrics)
             return metrics
         if self._apply_fn is None:
@@ -1301,6 +1332,12 @@ class Engine:
             self.telemetry.on_step_end(self.global_steps)
         self._post_step(metrics)
         return metrics
+
+    def _pop_offload_stats(self) -> Optional[Dict[str, Any]]:
+        """The offload pipeline's per-step ledger, consumed exactly once."""
+        stats = getattr(self, "_last_offload_stats", None)
+        self._last_offload_stats = None
+        return stats
 
     # ================================================================ shared tail
     def _post_step(self, metrics: Dict[str, Any]):

@@ -187,15 +187,25 @@ def _master_tree(engine):
 
 
 # ------------------------------------------------------------------- full API
+def _mh_single_controller(engine) -> bool:
+    """Pipelined host-Adam offload where this process addresses EVERY
+    shard — the full-value API works straight off the host shard store."""
+    return engine._mh_offload is not None and jax.process_count() == 1
+
+
 def safe_get_full_fp32_param(engine, path) -> np.ndarray:
     """Full fp32 master value of one parameter (reference
     ``safe_get_full_fp32_param``, ``utils/tensor_fragment.py:101``): gathered
     across shards (a ``device_get`` on a sharded array assembles it), fetched
     from the host master under ZeRO-Offload."""
     if engine._mh_offload is not None:
-        raise RuntimeError(
-            "full-value access under multi-host offload needs a cross-host "
-            "gather — use safe_get_local_fp32_param on each controller")
+        if not _mh_single_controller(engine):
+            raise RuntimeError(
+                "full-value access under multi-host offload needs a "
+                "cross-host gather — use safe_get_local_fp32_param on each "
+                "controller")
+        return np.asarray(engine._mh_offload.full_leaf_value(
+            _mh_leaf_index(engine, path)), np.float32)
     leaf = resolve_param_path(_master_tree(engine), path)
     return np.asarray(jax.device_get(leaf), np.float32)
 
@@ -203,9 +213,21 @@ def safe_get_full_fp32_param(engine, path) -> np.ndarray:
 def safe_set_full_fp32_param(engine, path, value) -> None:
     """Write a full fp32 master value back (reference :117). The device
     working copy is refreshed so the next step sees the edit."""
-    if engine._mh_offload is not None:
+    if engine._mh_offload is not None and not _mh_single_controller(engine):
         raise RuntimeError("setting params under multi-host offload is not "
                            "supported (each controller owns one shard)")
+    if engine._mh_offload is not None:
+        mh = engine._mh_offload
+        li = _mh_leaf_index(engine, path)
+        value = np.asarray(value)
+        if tuple(value.shape) != tuple(mh._shapes[li]):
+            raise ValueError(f"shape mismatch: param {tuple(mh._shapes[li])} "
+                             f"vs value {value.shape}")
+        mh.set_leaf_value(li, value)
+        # refresh the device working copies from the edited master so the
+        # next step trains FROM the edit (debug path — one full push)
+        engine.params = engine._mh_push(mh.master_global_tree())
+        return
     import jax.numpy as jnp
 
     tree = _master_tree(engine)
@@ -228,13 +250,24 @@ def safe_set_full_fp32_param(engine, path, value) -> None:
         _replace_leaf(engine.params, path, new)
 
 
+_MH_MOMENT = {"exp_avg": "m", "mu": "m", "exp_avg_sq": "v", "nu": "v"}
+
+
 def safe_get_full_optimizer_state(engine, path, key: str) -> np.ndarray:
     """Full value of one optimizer moment (reference :133); ``key`` is
     ``exp_avg`` / ``exp_avg_sq`` (or an optax field name)."""
     if engine._mh_offload is not None:
-        raise RuntimeError(
-            "full-value access under multi-host offload needs a cross-host "
-            "gather — use safe_get_local_optimizer_state on each controller")
+        if not _mh_single_controller(engine):
+            raise RuntimeError(
+                "full-value access under multi-host offload needs a "
+                "cross-host gather — use safe_get_local_optimizer_state on "
+                "each controller")
+        which = _MH_MOMENT.get(key)
+        if which is None:
+            raise KeyError(f"host CPU Adam carries exp_avg/exp_avg_sq only; "
+                           f"got {key!r}")
+        return np.asarray(engine._mh_offload.full_moment_value(
+            _mh_leaf_index(engine, path), which))
     tree, _ = _moment_tree(engine, key)
     return np.asarray(jax.device_get(resolve_param_path(tree, path)))
 
@@ -244,8 +277,30 @@ def safe_set_full_optimizer_state(engine, path, value, key: str) -> None:
     placed with the old leaf's sharding/device, so stage placement is
     preserved; under NVMe offload the edited state is re-parked."""
     if engine._mh_offload is not None:
-        raise RuntimeError("setting optimizer state under multi-host offload "
-                           "is not supported")
+        if not _mh_single_controller(engine):
+            raise RuntimeError("setting optimizer state under multi-host "
+                               "offload is not supported")
+        mh = engine._mh_offload
+        which = _MH_MOMENT.get(key)
+        if which is None:
+            raise KeyError(f"host CPU Adam carries exp_avg/exp_avg_sq only; "
+                           f"got {key!r}")
+        li = _mh_leaf_index(engine, path)
+        value = np.asarray(value, np.float32)
+        if tuple(value.shape) != tuple(mh._shapes[li]):
+            raise ValueError(f"shape mismatch: state {tuple(mh._shapes[li])} "
+                             f"vs value {value.shape}")
+        store = mh.m if which == "m" else mh.v
+        from ..runtime.multihost_offload import _idx_key
+
+        for idx in mh._dev_index[li].values():
+            k = _idx_key(idx)
+            if mh.swapper is not None and k in mh._swap_keys[li]:
+                mh.swapper.swap_out(f"{which}/{li}/{k}",
+                                    np.array(value[idx], np.float32))
+            else:
+                store[li][k] = np.array(value[idx], np.float32)
+        return
     tree, _ = _moment_tree(engine, key)
     old = resolve_param_path(tree, path)
     value = np.asarray(value, np.asarray(old).dtype)

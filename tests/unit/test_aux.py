@@ -443,13 +443,14 @@ class TestBenchLadder:
         bench.main()
         rungs = [r for r, _ in seen]
         # kernels_micro now runs FIRST on TPU (banks compiled-kernel
-        # evidence before anything can hang); multichip (the CPU-sim pod
-        # decomposition rung) rides at the tail of both plans
+        # evidence before anything can hang); multichip and offload (the
+        # CPU-sim pod decomposition / beyond-HBM rungs) ride at the tail
+        # of both plans
         assert rungs == ["probe", "kernels_micro", "kernels", "train",
                          "serve", "serve_fused", "serve_goodput",
-                         "multichip"]
+                         "multichip", "offload"]
         # kernels timed out → remaining rungs run pinned to CPU
-        for i in (3, 4, 5, 6, 7):
+        for i in (3, 4, 5, 6, 7, 8):
             assert seen[i][1].get("JAX_PLATFORMS") == "cpu"
         lines = capsys.readouterr().out.strip().splitlines()
         head = _json.loads(lines[-1])
@@ -507,9 +508,10 @@ class TestBenchLadder:
         bench.main()
         cpu_rungs = [r for r, t in seen if t == "cpu"]
         tpu_rungs = [r for r, t in seen if t == "tpu"]
-        # multichip is the CPU virtual-device sim by construction — it runs
+        # multichip and offload are the CPU sim by construction — they run
         # under CPU_ENV even from the TPU plan
-        assert cpu_rungs == ["kernels_aot", "serve", "multichip"], seen
+        assert cpu_rungs == ["kernels_aot", "serve", "multichip",
+                             "offload"], seen
         # the full TPU plan ran, INCLUDING serve again on the TPU tier
         assert tpu_rungs == [r for r, _t, env, _c in bench.TPU_PLAN
                              if not env], seen

@@ -81,6 +81,37 @@ def test_reference_config_parses(tmp_path):
     assert cfg.compute_dtype.__name__ == "bfloat16"
 
 
+def test_offload_pipeline_knobs_parse_and_validate():
+    cfg = DSTpuConfig.from_config(
+        {"train_batch_size": 8,
+         "zero_optimization": {
+             "stage": 2,
+             "offload_optimizer": {"device": "nvme",
+                                   "bucket_size": 1 << 20,
+                                   "buffer_count": 3,
+                                   "overlap": False,
+                                   "pipeline": True}}}, dp_world_size=8)
+    off = cfg.zero.offload_optimizer
+    assert off.bucket_size == 1 << 20 and off.buffer_count == 3
+    assert off.pipeline and not off.overlap
+    # defaults: pipeline on, double-buffered window, 32 MiB buckets
+    d = cfg.zero.offload_param
+    assert d.pipeline and d.overlap and d.buffer_count == 2
+    assert d.bucket_size == 32 * 2 ** 20
+    import pytest
+
+    with pytest.raises(ValueError, match="bucket_size"):
+        DSTpuConfig.from_config(
+            {"train_batch_size": 8,
+             "zero_optimization": {"offload_optimizer": {
+                 "device": "cpu", "bucket_size": 0}}}, dp_world_size=8)
+    with pytest.raises(ValueError, match="buffer_count"):
+        DSTpuConfig.from_config(
+            {"train_batch_size": 8,
+             "zero_optimization": {"offload_optimizer": {
+                 "device": "cpu", "buffer_count": 0}}}, dp_world_size=8)
+
+
 def test_fp16_scale_config():
     cfg = DSTpuConfig.from_config(
         {"train_batch_size": 8,

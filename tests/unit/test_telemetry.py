@@ -527,9 +527,17 @@ class TestTelemetryOverhead:
         toy model — WITH the collective watchdog armed (ISSUE 9: its
         per-step cost is one ring record + two attribute stores; the pod
         commit protocol rides the checkpoint path, not the step path).
-        Medians over many steps; best-of-3 attempts to ride out CI noise
-        (the telemetry hot path is a few dict appends — the real margin is
-        orders of magnitude below the bound)."""
+
+        Deflaked (ISSUE 12 satellite): the toy step is sub-millisecond, so
+        host scheduling jitter alone regularly exceeds 5% of it — the old
+        pure-ratio guard tripped on a noisy box with telemetry entirely
+        innocent. Each attempt now CALIBRATES the box's noise floor by
+        measuring the telemetry-off engine twice (identical code either
+        side of the telemetry-on run); the pass bound is 5% of the best
+        off-median plus that measured same-engine spread. Medians over
+        many steps; best-of-3 attempts; the telemetry hot path is a few
+        dict appends — the real margin is orders of magnitude below the
+        bound."""
         hidden, warm, measure = 64, 5, 40
         cfg_off = simple_config()
         cfg_on = _telemetry_config(
@@ -542,16 +550,27 @@ class TestTelemetryOverhead:
         try:
             data = random_dataset(e_off.train_batch_size(),
                                   hidden_dim=hidden, n_batches=warm + measure)
-            ratios = []
+            attempts = []
             for _attempt in range(3):
-                t_off = self._median_step_time(e_off, data, measure)
+                t_off_a = self._median_step_time(e_off, data, measure)
                 t_on = self._median_step_time(e_on, data, measure)
-                ratios.append(t_on / t_off)
-                if ratios[-1] < 1.05:
+                t_off_b = self._median_step_time(e_off, data, measure)
+                t_off = min(t_off_a, t_off_b)
+                # calibrated floor: the spread between two identical
+                # telemetry-off runs IS this box's timing noise right now
+                noise = abs(t_off_a - t_off_b)
+                bound = 1.05 * t_off + noise
+                attempts.append((t_on, t_off, noise))
+                if t_on < bound:
                     break
-            assert min(ratios) < 1.05, (
-                f"telemetry overhead {100 * (min(ratios) - 1):.1f}% "
-                f"exceeds 5% (ratios={ratios})")
+            ok = any(t_on < 1.05 * t_off + noise
+                     for t_on, t_off, noise in attempts)
+            assert ok, (
+                "telemetry overhead exceeds 5% + measured noise floor: "
+                + "; ".join(
+                    f"on={t_on * 1e3:.3f}ms off={t_off * 1e3:.3f}ms "
+                    f"noise={noise * 1e3:.3f}ms"
+                    for t_on, t_off, noise in attempts))
         finally:
             if e_on.telemetry is not None:
                 e_on.telemetry.close()

@@ -83,7 +83,9 @@ class TestFragmentAccess:
     def test_offload_reads_host_master(self):
         engine, _ = _engine(zero_optimization={
             "stage": 2, "offload_optimizer": {"device": "cpu"}})
-        assert engine.master_params is not None
+        # pipelined host engine: master lives as numpy shards, the full
+        # view assembles them (single controller addresses every shard)
+        assert engine._mh_offload is not None
         w = safe_get_full_fp32_param(engine, PATH)
         assert w.dtype == np.float32
         m = safe_get_full_optimizer_state(engine, PATH, "exp_avg")
@@ -95,6 +97,28 @@ class TestFragmentAccess:
         dev = np.asarray(jax.device_get(engine.params["layer_0"]["w"]),
                          np.float32)
         np.testing.assert_allclose(dev, np.ones_like(w), rtol=1e-2)
+
+    def test_offload_nvme_moment_roundtrip(self, tmp_path):
+        engine, _ = _engine(zero_optimization={
+            "stage": 2, "offload_optimizer": {"device": "nvme",
+                                              "nvme_path": str(tmp_path)}})
+        m = safe_get_full_optimizer_state(engine, PATH, "exp_avg")
+        assert float(np.abs(m).max()) > 0
+        safe_set_full_optimizer_state(engine, PATH, np.zeros_like(m),
+                                      "exp_avg")
+        np.testing.assert_array_equal(
+            safe_get_full_optimizer_state(engine, PATH, "exp_avg"),
+            np.zeros_like(m))
+
+    def test_offload_legacy_reads_host_master(self):
+        engine, _ = _engine(zero_optimization={
+            "stage": 2, "offload_optimizer": {"device": "cpu",
+                                              "pipeline": False}})
+        assert engine.master_params is not None
+        w = safe_get_full_fp32_param(engine, PATH)
+        assert w.dtype == np.float32
+        m = safe_get_full_optimizer_state(engine, PATH, "exp_avg")
+        assert m.shape == w.shape and float(np.abs(m).max()) > 0
 
     def test_grad_visibility(self):
         engine, batch = _engine(zero_optimization={"stage": 2})

@@ -96,8 +96,8 @@ def goodput_summary(records: List[Dict[str, Any]]) -> List[str]:
         return lines
     s = summaries[-1].get("data") or {}
     total = float(s.get("total", 0.0)) or 1e-9
-    cats = [k for k in ("productive", "checkpoint", "compile", "startup",
-                        "other") if k in s]
+    cats = [k for k in ("productive", "checkpoint", "compile",
+                        "offload_stall", "startup", "other") if k in s]
     accounted = sum(float(s[c]) for c in cats)
     for c in cats:
         v = float(s[c])
@@ -147,6 +147,48 @@ def events_summary(records: List[Dict[str, Any]]) -> List[str]:
             lines.append(f"    {name} = {metrics[name]}")
     if len(lines) == 1:
         lines.append("  (none)")
+    return lines
+
+
+def offload_summary(records: List[Dict[str, Any]]) -> List[str]:
+    """Hierarchical-offload view from ``offload/step`` records
+    (``runtime/offload_pipeline.py`` ``OffloadStats`` shape): bytes and
+    effective GB/s per direction, host fp32-Adam seconds, exposed stall,
+    and overlap efficiency (1 − exposed/total transfer time)."""
+    steps = [r.get("data") or {} for r in records
+             if r.get("kind") == "event" and r.get("name") == "offload/step"]
+    if not steps:
+        return []
+    tot: Dict[str, float] = {}
+    for d in steps:
+        for k, v in d.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                tot[k] = tot.get(k, 0.0) + float(v)
+    lines = [f"offload pipeline ({len(steps)} offloaded step(s), "
+             f"{int(tot.get('n_buckets', 0) / max(1, len(steps)))} "
+             f"bucket(s)/step)"]
+    for direction, label in (("d2h", "D2H grad pull"),
+                             ("h2d", "H2D master push"),
+                             ("nvme_read", "NVMe moment read"),
+                             ("nvme_write", "NVMe moment write")):
+        nbytes = tot.get(f"{direction}_bytes", 0.0)
+        if not nbytes:
+            continue
+        secs = tot.get(f"{direction}_s", 0.0)
+        gbps = f"{nbytes / 1e9 / secs:7.2f} GB/s" if secs > 0 else \
+            "    (async)"
+        lines.append(f"  {label:<18}{nbytes / 2**20:10.1f} MiB  {gbps}")
+    lines.append(f"  host compute      {_fmt_s(tot.get('host_compute_s', 0.0)):>10}")
+    lines.append(f"  exposed stall     {_fmt_s(tot.get('stall_s', 0.0)):>10}")
+    transfer = tot.get("transfer_s", 0.0)
+    if transfer > 0:
+        eff = min(1.0, max(0.0, 1.0 - tot.get("stall_s", 0.0) / transfer))
+        lines.append(f"  overlap efficiency {eff:8.2f}  (1 - exposed/total "
+                     f"transfer)")
+    hwm = max((float(d.get("window_hwm_bytes", 0) or 0) for d in steps),
+              default=0.0)
+    if hwm:
+        lines.append(f"  moment-window high-water {hwm / 2**20:8.1f} MiB")
     return lines
 
 
@@ -278,6 +320,10 @@ def render(paths: List[str], last: int = 20) -> Optional[str]:
     out.append("")
     out.extend(events_summary(first))
     all_records = [r for recs in per_rank.values() for r in recs]
+    offload = offload_summary(all_records)
+    if offload:
+        out.append("")
+        out.extend(offload)
     recovery = serve_recovery_summary(all_records)
     if recovery:
         out.append("")
