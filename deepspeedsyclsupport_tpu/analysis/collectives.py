@@ -69,8 +69,12 @@ class CollectiveExpectation:
         return self.param_gather_bytes + self.grad_sync_bytes
 
 
-def _leaf_entries(tree: Any, shardings: Any = None) -> List[Tuple[int, bool]]:
-    """[(full_bytes, fsdp_sharded)] per array leaf of ``tree``."""
+def _leaf_entries(tree: Any, shardings: Any = None,
+                  itemsize: int = None) -> List[Tuple[int, bool]]:
+    """[(full_bytes, fsdp_sharded)] per array leaf of ``tree``.
+    ``itemsize`` overrides each leaf's dtype width — ``itemsize=1`` yields
+    the int8-transport byte signature of every leaf (the quantized
+    collectives' payload size, ``comm/quantized.py``)."""
     import jax
     from jax.sharding import NamedSharding
 
@@ -84,7 +88,7 @@ def _leaf_entries(tree: Any, shardings: Any = None) -> List[Tuple[int, bool]]:
         if not shape:
             continue  # scalars sync in the scalar class
         dt = np.dtype(getattr(leaf, "dtype", np.float32))
-        nbytes = int(math.prod(shape)) * dt.itemsize
+        nbytes = int(math.prod(shape)) * (itemsize or dt.itemsize)
         s = s if s is not None else getattr(leaf, "sharding", None)
         spec = getattr(s, "spec", None) or ()
         axes = {a for e in spec for a in
@@ -170,20 +174,39 @@ def classify_collectives(census: Sequence[Dict[str, Any]],
     * ``grad_sync`` — an all-reduce/reduce-scatter whose payload equals any
       param leaf's full bytes (grads are param-shaped);
     * ``scalar_sync`` — payload ≤ ``SCALAR_BYTES`` (loss/overflow/norm);
-    * ``other`` — everything else: exotic grad-sync lowerings (all-to-all
-      + local reduce) and genuine resharding traffic. A canonical layout
-      leaves this class empty; growth here is the resharding signal.
+    * ``other`` — everything else: quantization scale sidecars, exotic
+      grad-sync lowerings and genuine resharding traffic. A canonical
+      layout leaves this class empty; growth here is the resharding signal.
+
+    Quantized transports (ZeRO++ qwZ int8 all-gather / qgZ int8
+    all-to-all quant-reduce, ``comm/quantized.py``) are recognized by the
+    ONE-byte-per-element signature: an all-gather moving exactly a sharded
+    param's element count is that param's quantized gather, an
+    all-reduce/reduce-scatter/all-to-all moving a grad leaf's element
+    count is its quantized sync. The fp32 block scales ride separate small
+    collectives and land in ``other``/``scalar_sync`` — honest: they are
+    overhead the quantization pays, not param/grad payload. (A same-dtype
+    leaf whose byte size collides with another leaf's element count is
+    caught by the full-dtype clauses first.)
     """
     entries = _leaf_entries(params, param_shardings)
     param_sizes = {b for b, _ in entries}
     sharded_sizes = {b for b, s in entries if s}
+    q_entries = _leaf_entries(params, param_shardings, itemsize=1)
+    q_param_sizes = {b for b, _ in q_entries}
+    q_sharded_sizes = {b for b, s in q_entries if s}
     out = CollectiveClasses()
     for rec in census:
         if rec["bytes"] <= SCALAR_BYTES:
             out.scalar_sync.append(rec)
         elif rec["op"] == "all-gather" and rec["bytes"] in sharded_sizes:
             out.param_gather.append(rec)
+        elif rec["op"] == "all-gather" and rec["bytes"] in q_sharded_sizes:
+            out.param_gather.append(rec)
         elif rec["op"] in GRAD_SYNC_OPS and rec["bytes"] in param_sizes:
+            out.grad_sync.append(rec)
+        elif rec["op"] in GRAD_SYNC_OPS + ("all-to-all",) \
+                and rec["bytes"] in q_param_sizes:
             out.grad_sync.append(rec)
         else:
             out.other.append(rec)

@@ -19,6 +19,10 @@ scheduling:
 * :mod:`.supervisor` — serving-plane fault tolerance: request journal,
   crash-replay recovery, replica supervisor, rc-219 stuck-decode contract
   (``docs/serving.md`` "failure contract")
+* :mod:`.fleet` — fleet control plane over N supervised replicas: affinity
+  router with fleet-edge admission, replica pool lifecycle (rolling
+  restart, hot respawn), journal-based cross-replica failover
+  (``docs/serving.md`` "fleet control plane")
 """
 from .config import RaggedInferenceConfig, ServingPolicyConfig  # noqa: F401
 from .engine_v2 import InferenceEngineV2  # noqa: F401
@@ -28,6 +32,8 @@ from .supervisor import (RequestJournal, ReplayRequest,  # noqa: F401
                          ReplicaSupervisor, SERVE_HANG_EXIT_CODE,
                          load_journal, reconstruct_outputs,
                          recover_requests)
+from .fleet import (FleetConfig, FleetRequest, FleetRouter,  # noqa: F401
+                    LocalReplica, ProcessReplica, ReplicaPool)
 
 
 def build_hf_engine(path: str, **config) -> "InferenceEngineV2":

@@ -1,0 +1,39 @@
+"""Serving fleet control plane over N replica serving processes.
+
+PR 4 gave one replica an SLA admission gate and PR 11 made that replica
+crash-replayable; this package coordinates **many** of them — the layer the
+TPU serving deployments profiled in "Fine-Tuning and Serving Gemma on
+Cloud TPU" (PAPERS.md) put user-visible goodput behind: fleet-level
+routing, lifecycle and failover, not single-engine throughput.
+
+* :mod:`.router` — :class:`FleetRouter`: fleet-edge admission (the
+  per-replica ``CapacityModel`` math aggregated across ready replicas, so
+  hopeless requests shed at the edge before any replica queues), placement
+  by SLA slack + measured capacity + tenant/session **affinity** (sticky
+  keys so same-tenant streams co-locate for future prefix reuse; policy
+  pluggable), and health gating (stale heartbeat or draining replicas drop
+  out of rotation).
+* :mod:`.pool` — :class:`ReplicaPool`: start/stop/drain orchestration over
+  the PR 11 :class:`~..supervisor.ReplicaSupervisor` drain contract —
+  rolling restart drains one replica at a time while the router steers new
+  work away; crashed workers hot-respawn through the supervisor's existing
+  elastic machinery, and the pool respawns supervisors that give up.
+* :mod:`.failover` — journal-based **cross-replica** failover: when a
+  replica dies for good, the router loads its request journals and
+  re-admits each in-flight stream on a *surviving* replica from its
+  emitted-token watermark (context rebuilt prompt+prefix, exactly-once
+  closes) — recovery time is routing latency, not restart latency.
+* :mod:`.cli` — ``python -m deepspeedsyclsupport_tpu.inference.v2.fleet
+  --spec fleet.json``: the multi-process fleet loop the chaos e2e drives.
+
+``Fleet/*`` telemetry (strict registry) and the offline view live in
+``monitor/telemetry.py`` and ``tools/trace_report.py --fleet``. See
+``docs/serving.md`` ("fleet control plane") for the failover decision
+table and the rolling-restart protocol.
+"""
+from .failover import (FailoverClaim, claim_in_flight,  # noqa: F401
+                       claim_uids, read_claims)
+from .pool import ProcessReplica, ReplicaPool  # noqa: F401
+from .router import (FleetConfig, FleetEvent, FleetRequest,  # noqa: F401
+                     FleetRouter, LocalReplica, ReplicaEndpoint,
+                     slack_affinity_placement)

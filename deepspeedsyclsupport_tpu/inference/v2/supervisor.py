@@ -439,9 +439,16 @@ def journal_path(journal_dir: str, rank: int = 0,
     """Per-incarnation journal filename — the ONE place the
     ``journal_rank<r>.att<N>.jsonl`` convention lives (``_journal_files``
     discovers it, the worker and bench construct it). ``attempt`` defaults
-    to this incarnation's ``DSTPU_ELASTIC_ATTEMPT``."""
+    to this incarnation's ``DSTPU_ELASTIC_ATTEMPT``. Under a fleet pool,
+    ``DSTPU_FLEET_GEN`` (the supervisor *generation* — bumped on every
+    pool respawn) namespaces the attempt so a respawned supervisor's
+    attempt 0 never appends to a dead generation's file — appending would
+    scramble ``_journal_files``'s oldest-first mtime merge."""
     if attempt is None:
         attempt = os.environ.get("DSTPU_ELASTIC_ATTEMPT", "0")
+    gen = os.environ.get("DSTPU_FLEET_GEN")
+    if gen is not None:
+        attempt = f"{gen}.{attempt}"
     return os.path.join(journal_dir, f"journal_rank{rank}.att{attempt}.jsonl")
 
 
@@ -456,6 +463,16 @@ def serve_worker(spec_path: str) -> int:
     dict — ``journal_path`` is filled in per incarnation), ``journal_dir``
     (required), ``out`` (output JSON path), ``requests``:
     ``[{"uid", "tokens", "max_new_tokens", "tenant"?, "rate_sla"?}]``.
+
+    Fleet mode (``inference/v2/fleet``) adds: ``spool_dir`` — serve
+    request files a router drops there (``replayed: true`` entries go
+    through :meth:`~.serving.ServingSession.replay`; replica-side sheds
+    are journaled admit+close so the router observes closure);
+    ``stop_file`` — exit 0 once it exists and everything is drained;
+    ``recover`` (default true) — replay prior incarnations' journals at
+    startup. Streams claimed by a router failover
+    (``fleet/failover_claim.json`` in the journal dir) are never
+    recovered or re-ingested here — they belong to a surviving replica.
     """
     with open(spec_path) as f:
         spec = json.load(f)
@@ -466,6 +483,7 @@ def serve_worker(spec_path: str) -> int:
     from ...monitor.telemetry import Heartbeat
     from .config import ServingPolicyConfig
     from .engine_v2 import InferenceEngineV2
+    from .fleet.failover import read_claims
     from .serving import ServingSession
 
     model = build_model(spec.get("model", "tiny"),
@@ -479,14 +497,24 @@ def serve_worker(spec_path: str) -> int:
     # records are the replayed admits (prior incarnations stay read-only)
     prior = [p for p in _journal_files(journal_dir) if p != jpath]
     states, last_t = load_journal(prior)
+    claim = read_claims(journal_dir)
+    # router-claimed streams were failed over to a surviving replica —
+    # recovering them here would double-serve (the exactly-once contract)
+    recoverable = {u: st for u, st in states.items() if not claim.covers(u)}
     session = ServingSession(eng, policy)
-    summary = recover_requests(session, states, last_t)
-    handled = set(states)  # closed, replayed or replay-shed — never resubmit
+    if spec.get("recover", True):
+        summary = recover_requests(session, recoverable, last_t)
+    else:
+        summary = {"replayed": [], "shed": [], "completed": [],
+                   "skipped_closed": sorted(recoverable),
+                   "time_to_recover_s": None}
+    # journaled, claimed, replayed or replay-shed — never resubmit
+    handled = set(states) | {int(u) for u in claim.uids}
     heartbeat = Heartbeat(os.path.join(journal_dir, "heartbeat_rank0.json"),
                           interval_s=0.2)
-    # drain contract: SIGTERM = stop ADMITTING and finish live streams (all
-    # spec requests are submitted below, so the flag only gates resubmits
-    # in future spec shapes) — store-only handler, drained by the loop
+    # drain contract: SIGTERM = stop ADMITTING (spec resubmits AND spool
+    # ingestion) and finish live streams — store-only handler, drained by
+    # the loop
     drain = {"pending": False}
 
     def _on_term(signum, frame):
@@ -495,21 +523,113 @@ def serve_worker(spec_path: str) -> int:
     signal.signal(signal.SIGTERM, _on_term)
 
     outcomes: Dict[int, str] = {}
-    for r in spec.get("requests", []):
+
+    def _admit(r: Dict[str, Any]) -> None:
         uid = int(r["uid"])
         if uid in handled:
-            continue
+            return
+        handled.add(uid)
+        if r.get("replayed"):
+            outcomes[uid] = session.replay(
+                uid, r["tokens"], int(r["max_new_tokens"]),
+                emitted_tokens=r.get("out", ()),
+                tenant=r.get("tenant", "default"),
+                rate_sla=r.get("rate_sla"))
+            return
         outcomes[uid] = session.submit(
             uid, r["tokens"], int(r["max_new_tokens"]),
             tenant=r.get("tenant", "default"),
+            ttft_sla_s=r.get("ttft_sla_s"),
             rate_sla=r.get("rate_sla"))
+        if outcomes[uid] == "shed" and session.journal is not None:
+            # submit-time sheds are synchronous to a LOCAL caller, but a
+            # router only sees the journal — give it the terminal record
+            session.journal.admit(uid, r["tokens"],
+                                  int(r["max_new_tokens"]),
+                                  tenant=r.get("tenant", "default"),
+                                  rate_sla=r.get("rate_sla") or 0.0)
+            session.journal.close_request(uid, "shed:replica")
+
+    for r in spec.get("requests", []):
+        _admit(r)
+
+    spool_dir = spec.get("spool_dir")
+    stop_file = spec.get("stop_file")
+    consumed: set = set()
+    spool_seen = {"mtime": -1}
+
+    def _ingest_spool(force: bool = False) -> int:
+        """Submit new spool files in sequence order; returns how many.
+        The scan is gated on the directory's mtime — this runs every
+        scheduler tick, and re-listing (plus re-parsing the claim file)
+        for a spool that has not changed is pure waste in the decode hot
+        loop. ``force`` bypasses the gate (the stop check, and a periodic
+        sweep covering coarse-mtime filesystems where a rename inside the
+        same timestamp granule would otherwise be invisible)."""
+        try:
+            mtime = os.stat(spool_dir).st_mtime_ns
+        except OSError:
+            return 0
+        if not force and mtime == spool_seen["mtime"]:
+            return 0
+        try:
+            names = sorted(os.listdir(spool_dir))
+        except OSError:
+            return 0
+        fresh = [nm for nm in names
+                 if nm.endswith(".json") and nm not in consumed]
+        if not fresh:
+            spool_seen["mtime"] = mtime
+            return 0
+        n = 0
+        retry = False
+        fresh_claim = read_claims(journal_dir)
+        for name in fresh:
+            try:
+                with open(os.path.join(spool_dir, name)) as f:
+                    r = json.load(f)
+            except (OSError, ValueError):
+                retry = True
+                continue  # racing the atomic rename — retry next pass
+            consumed.add(name)
+            uid = int(r["uid"])
+            if fresh_claim.covers(uid):
+                handled.add(uid)
+                continue  # failed over elsewhere while we were down
+            if uid not in handled:
+                _admit(r)
+                n += 1
+        if not retry:  # a deferred file keeps the scan hot until it lands
+            spool_seen["mtime"] = mtime
+        return n
+
     rounds = 0
-    while not session.idle:
-        events = session.step()
-        rounds += 1
-        heartbeat.beat(rounds)
-        if not events:
-            time.sleep(0.001)
+    if spool_dir:
+        while True:
+            if not drain["pending"]:
+                _ingest_spool(force=(rounds % 64 == 0))
+            events = session.step() if not session.idle else []
+            rounds += 1
+            heartbeat.beat(rounds)
+            if drain["pending"]:
+                if session.idle:
+                    break
+                continue
+            if stop_file and os.path.exists(stop_file) and session.idle:
+                # one last ingest (forced): a request spooled between the
+                # previous pass and the stop marker must not strand
+                if not _ingest_spool(force=True):
+                    break
+                continue
+            if not events:
+                time.sleep(0.002)
+    else:
+        while not session.idle:
+            events = session.step()
+            rounds += 1
+            heartbeat.beat(rounds)
+            if not events:
+                time.sleep(0.001)
     session.close()
     # the journal (all incarnations) is the delivery record — reconstruct
     # the full per-uid sequences from it so the output survives any number

@@ -37,9 +37,12 @@ def constrain(x: jnp.ndarray, *spec) -> jnp.ndarray:
         return x
     # inside a shard_map manual region (ZeRO++ explicit step, pipeline ring)
     # a constraint naming manual axes is rejected at lowering — and the data
-    # is already placed per-shard there, so the constraint is meaningless
-    manual = set(getattr(jax.sharding.get_abstract_mesh(), "manual_axes",
-                         ()) or ())
+    # is already placed per-shard there, so the constraint is meaningless.
+    # get_abstract_mesh is a modern spelling (shimmed by utils/jax_compat);
+    # without it — old jax, shims off — there is no manual-region tracking
+    # to consult, so fall through to the constraint attempt.
+    _gam = getattr(jax.sharding, "get_abstract_mesh", None)
+    manual = set(getattr(_gam(), "manual_axes", ()) or ()) if _gam else set()
     if manual:
         used = {a for s in spec
                 for a in (s if isinstance(s, (tuple, list)) else (s,)) if a}

@@ -152,17 +152,30 @@ EVENT_NAMES = frozenset(
      # lint resolves literals): counters + the time-to-recover histogram
      "Serve/recovery.replays", "Serve/recovery.replay_sheds",
      "Serve/recovery.serve_hang_aborts",
-     "Serve/recovery.time_to_recover_s"}
+     "Serve/recovery.time_to_recover_s",
+     # serving fleet control plane (inference/v2/fleet — router edge
+     # admission, affinity placement, journal-based cross-replica
+     # failover; docs/serving.md "fleet control plane"): routed/shed/
+     # completed counters, failover accounting, rotation gauges and the
+     # routed-TTFT histogram. Per-replica members (live/queued per
+     # replica id) are data-dependent and ride the Fleet/replica. prefix.
+     "Fleet/routed", "Fleet/shed", "Fleet/completed", "Fleet/affinity_hits",
+     "Fleet/failover.deaths", "Fleet/failover.replays",
+     "Fleet/failover.replay_sheds",
+     "Fleet/replicas_ready", "Fleet/inflight", "Fleet/routed_ttft_s"}
     | {f"Serve/{h}/{q}" for h in ("ttft_s", "itl_s",
                                   "recovery.time_to_recover_s")
+       for q in ("p50", "p95", "p99")}
+    | {f"Fleet/{h}/{q}" for h in ("routed_ttft_s",)
        for q in ("p50", "p95", "p99")}
     | {f"Resilience/{n}" for n in ResilienceCounters.NAMES})
 
 #: Families whose member names are data-dependent (collective op mix, user
 #: extensions, pod-scope aggregates whose per-class / per-rank member names
-#: depend on the parallelism layout — see ``monitor/pod.py``). A prefix
-#: declares the whole family.
-EVENT_PREFIXES = ("Comm/", "Custom/", "Pod/")
+#: depend on the parallelism layout — see ``monitor/pod.py``; per-replica
+#: fleet gauges keyed by replica id — ``inference/v2/fleet/router.py``). A
+#: prefix declares the whole family.
+EVENT_PREFIXES = ("Comm/", "Custom/", "Pod/", "Fleet/replica.")
 
 _extra_event_names: set = set()
 _warned_names: set = set()

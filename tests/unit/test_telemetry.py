@@ -151,7 +151,10 @@ class TestEventRegistry:
         for name in tel.EVENT_NAMES:
             assert tel.EVENT_NAME_RE.match(name), name
         for prefix in tel.EVENT_PREFIXES:
-            assert prefix.endswith("/")
+            # a family prefix must end AT a delimiter so startswith matching
+            # can't cut a name mid-word: "/" (group boundary) or "." (the
+            # dot-tail convention — e.g. Fleet/replica.<id>.live)
+            assert prefix.endswith(("/", ".")), prefix
 
     def test_strict_mode_rejects_typo(self, tmp_path):
         assert tel.events_strict()  # conftest exports DSTPU_STRICT_EVENTS=1

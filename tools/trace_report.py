@@ -12,6 +12,7 @@ Usage::
     python tools/trace_report.py telemetry_logs/            # whole directory
     python tools/trace_report.py 'logs/flightrec_rank*.jsonl' --last 30
     python tools/trace_report.py telemetry_logs/ --pod      # pod-scope view
+    python tools/trace_report.py fleet_root/ --fleet        # fleet view
 
 Inputs may be directories (their ``flightrec*.jsonl``), glob patterns, or
 explicit files; rank ids are inferred from the ``rank<N>`` filename
@@ -267,6 +268,154 @@ def serve_recovery_summary(records: List[Dict[str, Any]]) -> List[str]:
     return lines
 
 
+def _simple_quantiles(values: List[float],
+                      qs=(0.5, 0.95, 0.99)) -> Dict[float, float]:
+    """Nearest-rank quantiles over raw samples (stdlib; the fleet view has
+    the individual TTFTs, no bucketed histogram needed)."""
+    if not values:
+        return {}
+    s = sorted(values)
+    return {q: s[min(len(s) - 1, max(0, round(q * (len(s) - 1))))]
+            for q in qs}
+
+
+def discover_fleet(root: str):
+    """A fleet root (``inference/v2/fleet``) holds one ``replica<i>/``
+    subdir per replica (journals under ``journal/`` or flat) plus the
+    router's ``router*.jsonl`` stream. Returns
+    ``(replicas: {id: (journal_dir, [files])}, router_files)``."""
+    import glob as _glob
+
+    replicas: Dict[str, Any] = {}
+    for sub in sorted(_glob.glob(os.path.join(root, "replica*"))):
+        if not os.path.isdir(sub):
+            continue
+        rid = os.path.basename(sub)[len("replica"):] or sub
+        jdir = os.path.join(sub, "journal")
+        if not os.path.isdir(jdir):
+            jdir = sub
+        files = sorted(_glob.glob(os.path.join(jdir, "journal_rank*.jsonl")),
+                       key=lambda p: (os.path.getmtime(p), p))
+        if files:
+            replicas[rid] = (jdir, files)
+    router_files = sorted(_glob.glob(os.path.join(root, "router*.jsonl")))
+    return replicas, router_files
+
+
+def fleet_summary(root: str) -> Optional[str]:
+    """Merged cross-replica fleet view: per-replica journal lifecycle
+    counts, fleet-level closure (exactly-once check included), failover
+    accounting from the router stream + claim files, and routed-TTFT
+    quantiles joined route-record → first-emit across processes."""
+    replicas, router_files = discover_fleet(root)
+    if not replicas and not router_files:
+        return None
+    lines = [f"fleet report — {len(replicas)} replica(s), router stream: "
+             f"{'yes' if router_files else 'no'}"]
+    all_uids: set = set()
+    closed_uids: set = set()
+    close_counts: Dict[Any, int] = {}
+    first_emit_t: Dict[Any, float] = {}
+    total_tokens = 0
+    claims = 0
+    for rid, (jdir, files) in sorted(replicas.items()):
+        admits: set = set()
+        replayed: set = set()
+        closes: Dict[Any, str] = {}
+        tokens = 0
+        for path in files:
+            for rec in load_records(path):
+                name = rec.get("name")
+                data = rec.get("data") or {}
+                uid = data.get("uid")
+                if uid is None:
+                    continue
+                if name == "serve/admit":
+                    admits.add(uid)
+                    if data.get("replayed"):
+                        replayed.add(uid)
+                elif name == "serve/emit":
+                    tokens += len(data.get("tokens", []))
+                    t = rec.get("t")
+                    if t is not None and uid not in first_emit_t:
+                        first_emit_t[uid] = float(t)
+                elif name == "serve/close":
+                    closes[uid] = data.get("reason", "?")
+                    close_counts[uid] = close_counts.get(uid, 0) + 1
+        all_uids |= admits
+        closed_uids |= set(closes)
+        total_tokens += tokens
+        reasons: Dict[str, int] = {}
+        for reason in closes.values():
+            reasons[reason] = reasons.get(reason, 0) + 1
+        rtxt = (", ".join(f"{k}={v}" for k, v in sorted(reasons.items()))
+                or "-")
+        lines.append(
+            f"  replica{rid}: {len(admits)} request(s) "
+            f"({len(replayed)} replayed-in), {len(closes)} closed, "
+            f"{len(admits) - len(closes)} left in flight here, "
+            f"{tokens} token(s); closes: {rtxt}")
+        try:
+            with open(os.path.join(jdir, "failover_claim.json")) as f:
+                claims += len((json.load(f) or {}).get("uids", {}))
+        except (OSError, ValueError):
+            pass
+    dupes = {u: n for u, n in close_counts.items() if n > 1}
+    lines.append(f"  fleet: {len(all_uids)} unique request(s), "
+                 f"{len(closed_uids)} closed, "
+                 f"{len(all_uids - closed_uids)} in flight, "
+                 f"{total_tokens} token(s)")
+    lines.append(f"  close records per closed request: "
+                 + ("exactly one (exactly-once holds)" if not dupes else
+                    f"DUPLICATES for {len(dupes)} uid(s): "
+                    f"{sorted(dupes)[:10]}"))
+    # router stream: route times (for TTFT join), failover ledger, the
+    # final Fleet/* counter snapshot from the dump marker
+    route_t: Dict[Any, float] = {}
+    deaths = replays = replay_sheds = sheds = 0
+    counters: Dict[str, Any] = {}
+    for path in router_files:
+        for rec in load_records(path):
+            name = rec.get("name")
+            data = rec.get("data") or {}
+            if name == "fleet/route" and "uid" in data:
+                t = rec.get("t")
+                if t is not None:
+                    route_t.setdefault(data["uid"], float(t))
+            elif name == "fleet/death":
+                deaths += 1
+            elif name == "fleet/shed":
+                sheds += 1
+            elif name == "fleet/failover":
+                if data.get("outcome") == "shed":
+                    replay_sheds += 1
+                elif data.get("outcome") in ("replayed", "dispatched"):
+                    replays += 1
+            if rec.get("kind") == "dump":
+                for k, v in ((rec.get("data") or {}).get("metrics", {})
+                             .get("counters", {})).items():
+                    if k.startswith("Fleet/"):
+                        counters[k] = v
+    if router_files:
+        lines.append(f"  failover: {deaths} death(s), {claims} claimed "
+                     f"stream(s), {replays} replay(s), "
+                     f"{replay_sheds} replay shed(s), "
+                     f"{sheds} router shed record(s)")
+        ttfts = [first_emit_t[u] - t for u, t in route_t.items()
+                 if u in first_emit_t and first_emit_t[u] >= t]
+        if ttfts:
+            qs = _simple_quantiles(ttfts)
+            lines.append("  routed TTFT (" + f"{len(ttfts)} sample(s)): "
+                         + ", ".join(f"p{int(q * 100)}={v:.3f}s"
+                                     for q, v in qs.items()))
+        for name in sorted(counters):
+            lines.append(f"  {name} = {counters[name]}")
+    elif claims:
+        lines.append(f"  failover: {claims} claimed stream(s) "
+                     f"(no router stream found)")
+    return "\n".join(lines)
+
+
 def straggler_summary(per_rank: Dict[int, List[Dict[str, Any]]]) -> List[str]:
     """``per_rank`` is keyed by rank id (inferred by :func:`render` from
     filenames / stream metadata — callers no longer hand-build the dict)."""
@@ -347,9 +496,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="pod-scope report instead (alias for "
                          "tools/pod_report.py: clock-aligned skew, straggler "
                          "ledger, per-class bandwidth decomposition)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="serving-fleet report: merged cross-replica journal "
+                         "lifecycle, failover ledger and routed-TTFT "
+                         "quantiles from a fleet root directory "
+                         "(replica*/ + router.jsonl)")
     args = ap.parse_args(argv)
     if args.pod:
         return pod_report.main([*args.files, "--last", str(args.last)])
+    if args.fleet:
+        reports = [fleet_summary(os.path.expanduser(p)) for p in args.files]
+        reports = [r for r in reports if r]
+        if not reports:
+            print("no fleet records found in any input directory",
+                  file=sys.stderr)
+            return 2
+        print("\n\n".join(reports))
+        return 0
     report = render([os.path.expanduser(p) for p in args.files],
                     last=args.last)
     if report is None:
