@@ -387,9 +387,32 @@ def model_flops_per_token(cfg):
 
 
 def _measure(name, seq, micro_bs, steps, remat, platform,
-             attn_impl="auto"):
+             attn_impl="auto", topo_axes=None):
     """One bench rung: build → warmup/compile → timed steps → metrics dict.
-    Raises on OOM/compile failure; the caller's ladder steps down."""
+    Raises on OOM/compile failure; the caller's ladder steps down.
+
+    Every rung now runs under telemetry with ``telemetry.mfu`` on: the
+    warmup's third step is the captured clean-step window (outside the
+    timed loop, so the one deliberately-synced step never pollutes
+    tokens/s) and ``detail.mfu`` carries the full step-time attribution
+    ledger — achieved MFU, the peak→roofline→measured waterfall and the
+    per-region bound-by verdicts (docs/observability.md "MFU ledger")."""
+    import shutil
+    import tempfile
+
+    # scratch telemetry/trace dir for this rung only: the ledger dict is
+    # extracted before return, so the artifacts never outlive the attempt
+    # (the OOM ladder retries would otherwise pile dirs up in /tmp)
+    tdir = tempfile.mkdtemp(prefix="dstpu_bench_mfu_")
+    try:
+        return _measure_impl(name, seq, micro_bs, steps, remat, platform,
+                             attn_impl, topo_axes, tdir)
+    finally:
+        shutil.rmtree(tdir, ignore_errors=True)
+
+
+def _measure_impl(name, seq, micro_bs, steps, remat, platform, attn_impl,
+                  topo_axes, tdir):
     import jax
     import numpy as np
 
@@ -400,7 +423,7 @@ def _measure(name, seq, micro_bs, steps, remat, platform,
     cfg = get_config(name, remat=remat, max_seq_len=seq,
                      attn_impl=attn_impl)
     reset_world_topology()
-    topo = ds.build_topology(dp=1)
+    topo = ds.build_topology(**(topo_axes or {"dp": 1}))
     model = build_model(cfg)
     config = {
         "train_batch_size": micro_bs,
@@ -414,12 +437,24 @@ def _measure(name, seq, micro_bs, steps, remat, platform,
         # silent HBM doubling that shrinks the ladder's feasible rungs
         "activation_checkpointing": {"enabled": remat},
         "steps_per_print": 10_000,
+        "telemetry": {"enabled": True,
+                      "output_dir": tdir,
+                      "heartbeat": {"enabled": False},
+                      "mfu": {"enabled": True, "step": 3}},
     }
     engine, _, _, _ = ds.initialize(model=model, config=config, topology=topo)
     batch = {"input_ids": jax.random.randint(jax.random.PRNGKey(0),
                                              (micro_bs, seq), 0,
                                              cfg.vocab_size)}
-    for _ in range(2):
+    # 3 warmup steps: compile (1), warm (2), MFU window capture (3 — the
+    # one synced step, deliberately before the timed loop). If step 3
+    # recompiled, the engine re-arms the capture — DRAIN it here (bounded)
+    # so the synced window never lands inside the timed loop below.
+    for _ in range(3):
+        m = engine.train_batch(batch)
+    for _ in range(4):
+        if not getattr(engine, "_mfu_pending", False):
+            break
         m = engine.train_batch(batch)
     _sync(m["loss"])
 
@@ -445,12 +480,27 @@ def _measure(name, seq, micro_bs, steps, remat, platform,
                     "wasted_bytes": rep.wasted_bytes}
     except Exception as e:
         donation = {"ok": None, "error": str(e)[:200]}
+    # the MFU ledger from the captured window (same never-die contract)
+    try:
+        ledger = engine.mfu_ledger()
+        ledger.pop("window", None)
+    except Exception as e:
+        ledger = {"error": str(e)[:200]}
+    try:
+        engine.telemetry.close("bench")
+    except Exception:
+        pass
     return {
         "metric": f"train_tokens_per_sec_per_chip_{name}_seq{seq}",
         "value": round(tok_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / REFERENCE_MFU, 4),
-        "detail": {"platform": platform, "mfu": round(mfu, 4),
+        "detail": {"platform": platform,
+                   # detail.mfu is the LEDGER (dict) from this round on;
+                   # the headline scalar (tok/s-derived fraction of chip
+                   # peak, the pre-ledger detail.mfu) moves to mfu_headline
+                   "mfu": ledger,
+                   "mfu_headline": round(mfu, 4),
                    "tflops": round(achieved / 1e12, 2),
                    "micro_bs": micro_bs, "remat": remat,
                    "donation": donation,
@@ -544,6 +594,39 @@ def run_train():
             except Exception as e:
                 print(f"train variant {tag} failed: {str(e)[:200]}",
                       file=sys.stderr)
+
+
+# ======================================================================
+# rung: train_ring (ring-attention attn_impl A/B under the MFU ledger)
+# ======================================================================
+def run_train_ring():
+    """Ring-attention ``attn_impl`` A/B on a seq-sharded 2-device mesh
+    (CPU sim): the inline online-softmax ring (``ring:xla``) vs the
+    Pallas-flash per-block path (``ring:flash`` — interpret mode off-TPU,
+    so CPU prices dispatch structure, not kernel speed). Both arms run
+    under the MFU ledger, so each line's ``detail.mfu`` carries the
+    per-region attention time — the A/B the ROADMAP's long-sequence item
+    needs before the real-TPU run."""
+    jax = _child_jax()
+
+    platform = jax.devices()[0].platform
+    if len(jax.devices()) < 2:
+        _emit({"metric": "train_ring_skipped", "value": 0.0, "unit": "arms",
+               "vs_baseline": 0.0,
+               "detail": {"platform": platform,
+                          "reason": "needs >= 2 devices for the seq mesh"}})
+        return
+    for tag, impl, seq, micro, steps in (
+            ("xla", "ring:xla", 256, 4, 2),
+            ("flash", "ring:flash", 256, 4, 2)):
+        try:
+            r = _measure("tiny", seq, micro, steps, False, platform,
+                         attn_impl=impl, topo_axes={"dp": 1, "sp": 2})
+            r["metric"] = f"train_ring_{tag}_tokens_per_sec_per_chip"
+            _emit(r)
+        except Exception as e:
+            print(f"train_ring arm {tag} failed: {str(e)[:300]}",
+                  file=sys.stderr)
 
 
 # ======================================================================
@@ -2433,6 +2516,12 @@ class _ProbeWatcher:
 # multichip is the CPU virtual-device sim by construction — it runs under
 # CPU_ENV on both plans (on a TPU window it still measures the SPMD sim,
 # not the silicon, and is priced accordingly at the tail of the plan)
+# train_ring is likewise CPU-sim by construction: it needs a 2-virtual-
+# device seq mesh (forced host platform device count), and its flash arm
+# runs the Pallas kernels in interpret mode off-TPU — an A/B of dispatch
+# structure under the MFU ledger, not of kernel speed
+RING_ENV = {**CPU_ENV,
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2"}
 TPU_PLAN = [("kernels_micro", 400, {}, False),
             ("kernels", 600, {}, False),
             ("train", 1200, {}, True),
@@ -2441,7 +2530,8 @@ TPU_PLAN = [("kernels_micro", 400, {}, False),
             ("serve_goodput", 700, {}, True),
             ("multichip", 400, CPU_ENV, False),
             ("offload", 500, CPU_ENV, False),
-            ("fleet", 500, CPU_ENV, False)]
+            ("fleet", 500, CPU_ENV, False),
+            ("train_ring", 500, RING_ENV, False)]
 CPU_PLAN = [("kernels_aot", 400, CPU_ENV, False),
             ("serve", 500, CPU_ENV, False),
             ("serve_fused", 400, CPU_ENV, False),
@@ -2449,7 +2539,8 @@ CPU_PLAN = [("kernels_aot", 400, CPU_ENV, False),
             ("train", 700, CPU_ENV, False),
             ("multichip", 400, CPU_ENV, False),
             ("offload", 500, CPU_ENV, False),
-            ("fleet", 500, CPU_ENV, False)]
+            ("fleet", 500, CPU_ENV, False),
+            ("train_ring", 500, RING_ENV, False)]
 
 
 class _Killed(Exception):
@@ -2638,6 +2729,8 @@ if __name__ == "__main__":
         run_kernels_aot()
     elif rung == "train":
         run_train()
+    elif rung == "train_ring":
+        run_train_ring()
     elif rung == "serve":
         run_serve()
     elif rung == "serve_fused":

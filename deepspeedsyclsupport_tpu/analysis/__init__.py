@@ -25,6 +25,8 @@ from .collectives import (CollectiveClasses, CollectiveExpectation,
 from .donation import DonationReport, donation_audit
 from .dtype_audit import DtypeReport, dtype_audit
 from .resharding import ReshardingReport, resharding_audit
+from .roofline import (DEVICE_SPECS, DeviceSpec, device_spec, region_costs,
+                       roofline_table)
 
 __all__ = [
     "abstract_step_args",
@@ -33,4 +35,6 @@ __all__ = [
     "donation_audit", "DonationReport",
     "dtype_audit", "DtypeReport",
     "resharding_audit", "ReshardingReport",
+    "DeviceSpec", "DEVICE_SPECS", "device_spec", "region_costs",
+    "roofline_table",
 ]

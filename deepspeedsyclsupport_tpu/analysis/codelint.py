@@ -248,6 +248,81 @@ class UndeclaredEventName(Rule):
                 f"it to EVENT_NAMES / declare_events)", snippet)
 
 
+class UndeclaredRegionName(Rule):
+    name = "undeclared-region"
+    description = ("MFU region labels (region_scope(...) / 'mfu.<name>' "
+                   "scope literals) must resolve against monitor/mfu.py's "
+                   "SCOPE_REGIONS registry — a typo'd label silently "
+                   "orphans its region's time in the step-time ledger")
+
+    def __init__(self):
+        from ..monitor.mfu import SCOPE_PREFIX, SCOPE_REGIONS
+
+        self._regions = set(SCOPE_REGIONS)
+        self._prefix = SCOPE_PREFIX
+
+    def _bad(self, label: str) -> bool:
+        return label not in self._regions
+
+    def check(self, relpath, tree, source_lines):
+        if relpath.startswith(("tests/", "docs/")):
+            return
+        docstrings = _docstring_linenos(tree)
+        # region_scope("<literal>") calls with an undeclared region
+        region_call_args: Set[int] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if _call_name(node).split(".")[-1] not in ("region_scope",
+                                                       "named_scope"):
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                continue
+            region_call_args.add(id(arg))
+            s = arg.value
+            label = (s[len(self._prefix):]
+                     if s.startswith(self._prefix) else s)
+            is_scope_helper = _call_name(node).endswith("region_scope")
+            if not is_scope_helper and not s.startswith(self._prefix):
+                continue  # unrelated named_scope — not an MFU region
+            if self._bad(label) and not _suppressed(
+                    source_lines, node.lineno, self.name):
+                snippet = source_lines[node.lineno - 1].strip() \
+                    if node.lineno <= len(source_lines) else ""
+                yield Violation(
+                    self.name, relpath, node.lineno,
+                    f"MFU region {label!r} is not declared in "
+                    f"monitor/mfu.py SCOPE_REGIONS (typo, or add the "
+                    f"region there + to the MFU/region.* event family)",
+                    snippet)
+        # bare "mfu.<name>" literals anywhere else (building a label by
+        # hand bypasses region_scope's runtime check)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            if id(node) in region_call_args or node.lineno in docstrings:
+                continue
+            s = node.value
+            if not s.startswith(self._prefix) or "\n" in s or "/" in s:
+                continue
+            if s.endswith((".py", ".json", ".gz", ".txt", ".md")):
+                continue  # a filename (mfu.py, mfu_opmap.json), not a label
+            label = s[len(self._prefix):]
+            if not label or not label.replace("_", "").isalnum():
+                continue  # "mfu." prefix itself / regex fragments
+            if self._bad(label) and not _suppressed(
+                    source_lines, node.lineno, self.name):
+                snippet = source_lines[node.lineno - 1].strip() \
+                    if node.lineno <= len(source_lines) else ""
+                yield Violation(
+                    self.name, relpath, node.lineno,
+                    f"string {s!r} names MFU region {label!r} which is "
+                    f"not in monitor/mfu.py SCOPE_REGIONS", snippet)
+
+
 def _docstring_linenos(tree: ast.AST) -> Set[int]:
     """Line ranges of every docstring (multi-line strings included)."""
     out: Set[int] = set()
@@ -347,8 +422,8 @@ class HostSyncInStepPath(Rule):
 
 
 ALL_RULES: Sequence[Callable[[], Rule]] = (
-    SignalHandlerSafety, UndeclaredEventName, WallClockInStepPath,
-    HostSyncInStepPath)
+    SignalHandlerSafety, UndeclaredEventName, UndeclaredRegionName,
+    WallClockInStepPath, HostSyncInStepPath)
 
 
 # -------------------------------------------------------------------- runner

@@ -261,7 +261,26 @@ def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
               kv_positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Attention dispatch — the seam where Pallas/SP implementations plug in
     (reference analog: the op-binding indirection of
-    ``ops/transformer/inference/op_binding/``)."""
+    ``ops/transformer/inference/op_binding/``).
+
+    Sequence-parallel impls take an inner (per-shard) implementation after
+    a colon — ``"ring:flash"`` / ``"ring:xla"`` / ``"ulysses:flash"`` /
+    ``"ulysses:xla"`` — the ``attn_impl`` spelling the bench's ring A/B
+    arms use; bare ``"ring"``/``"ulysses"`` auto-select (flash on TPU).
+    """
+    inner = None
+    if impl and ":" in impl:
+        impl, inner = impl.split(":", 1)
+        if impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"attn_impl {impl + ':' + inner!r}: only the "
+                f"sequence-parallel impls take an inner "
+                f"('ring:...'/'ulysses:...')")
+        if inner not in ("flash", "xla"):
+            # a typo'd inner silently falling back would make an A/B
+            # compare an arm against itself and report a bogus no-diff
+            raise ValueError(f"unknown inner attention impl {inner!r} "
+                             f"(flash | xla)")
     if (window is not None and not causal
             and kv_positions_below is None and kv_positions is None):
         # the window bound is one-sided (how far BACK a query sees) on every
@@ -305,12 +324,12 @@ def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if impl == "ring":
         from ..parallel.ring_attention import ring_attention
 
-        return ring_attention(q, k, v, causal=causal)
+        return ring_attention(q, k, v, causal=causal, inner=inner)
     if impl == "ulysses":
         from ..parallel.ulysses import ulysses_attention
 
         return ulysses_attention(q, k, v, causal=causal,
-                                 segment_ids=segment_ids)
+                                 segment_ids=segment_ids, inner=inner)
     return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids,
                                kv_positions_below=kv_positions_below,
                                kv_mask=kv_mask, alibi=alibi, window=window,
@@ -351,7 +370,23 @@ def attention_block(p: Params, x: jnp.ndarray, cfg: ModelConfig,
     current k/v at ``write_pos`` and attends over the cache (the role of the
     reference's ``linear_blocked_kv_rotary`` + ``blocked_flash`` kernels,
     ``inference/v2/kernels/ragged_ops/``). Returns (out, new_kv_cache).
+
+    The whole sublayer traces under the ``attn`` MFU region scope
+    (``monitor/mfu.py``): XLA stamps the label into every lowered op's
+    metadata (backward included — the transpose wrapper preserves it), so
+    the step-time attribution ledger can name attention's share of a
+    measured step.
     """
+    from ..monitor.mfu import region_scope
+
+    with region_scope("attn"):
+        return _attention_block_impl(p, x, cfg, positions, segment_ids,
+                                     kv_cache, impl, kv_mask, kv_positions,
+                                     window_override)
+
+
+def _attention_block_impl(p, x, cfg, positions, segment_ids, kv_cache, impl,
+                          kv_mask, kv_positions, window_override):
     b, s, d = x.shape
     q = jnp.einsum("bsd,dq->bsq", x, p["wq"])
     k = jnp.einsum("bsd,dk->bsk", x, p["wk"])
@@ -473,4 +508,8 @@ def std_mlp(p: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
 
 
 def mlp_block(p: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
-    return std_mlp(p, x, cfg) if cfg.mlp_type == "mlp" else glu_mlp(p, x, cfg)
+    from ..monitor.mfu import region_scope
+
+    with region_scope("mlp"):  # MFU-region label (see attention_block)
+        return (std_mlp(p, x, cfg) if cfg.mlp_type == "mlp"
+                else glu_mlp(p, x, cfg))

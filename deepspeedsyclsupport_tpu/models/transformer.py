@@ -150,9 +150,11 @@ class CausalLM:
 
         def run_mlp(y):
             if cfg.any_moe:
+                from ..monitor.mfu import region_scope
                 from ..parallel.moe import moe_mlp
 
-                return moe_mlp(p["moe"], y, cfg, rng)
+                with region_scope("mlp"):  # MoE is the mlp MFU region too
+                    return moe_mlp(p["moe"], y, cfg, rng)
             return mlp_block(p["mlp"], y, cfg), jnp.zeros((), jnp.float32)
 
         from .layers import _WINDOW_FROM_CFG
@@ -195,20 +197,24 @@ class CausalLM:
             positions = jnp.broadcast_to(positions, (b, s))
         rng = rng if rng is not None else jax.random.PRNGKey(0)
 
+        from ..monitor.mfu import region_scope
         from ..parallel.tensor_parallel import vocab_parallel_embedding
 
-        x = vocab_parallel_embedding(params["embed"]["embedding"], input_ids)
-        if cfg.pos_embed == "learned":
-            # same Megatron masked-lookup+psum pattern as the vocab table —
-            # a plain take on a row-sharded table makes SPMD full-remat
-            table = params["pos_embed"]["embedding"]
-            pos = jnp.clip(positions + cfg.pos_embed_offset, 0,
-                           table.shape[0] - 1)
-            x = x + vocab_parallel_embedding(table, pos).astype(x.dtype)
-        x = x.astype(jnp.dtype(cfg.dtype))
-        if cfg.embed_norm:
-            x = norm(x, params["embed_norm"], cfg)
-        x = constrain(x, BATCH, "seq", None)
+        with region_scope("embed"):  # MFU-region label (monitor/mfu.py)
+            x = vocab_parallel_embedding(params["embed"]["embedding"],
+                                         input_ids)
+            if cfg.pos_embed == "learned":
+                # same Megatron masked-lookup+psum pattern as the vocab
+                # table — a plain take on a row-sharded table makes SPMD
+                # full-remat
+                table = params["pos_embed"]["embedding"]
+                pos = jnp.clip(positions + cfg.pos_embed_offset, 0,
+                               table.shape[0] - 1)
+                x = x + vocab_parallel_embedding(table, pos).astype(x.dtype)
+            x = x.astype(jnp.dtype(cfg.dtype))
+            if cfg.embed_norm:
+                x = norm(x, params["embed_norm"], cfg)
+            x = constrain(x, BATCH, "seq", None)
 
         def layer_fn(x, p, ck, cv, rng_l, layer_idx=None):
             cache_slice = None
@@ -400,15 +406,19 @@ class CausalLM:
                 new_cache = KVCache(jnp.stack(nks), jnp.stack(nvs),
                                     cache.write_pos + s)
 
-        x = norm(x, params["final_norm"], cfg)
-        if cfg.tie_embeddings:
-            logits = jnp.einsum("bsd,vd->bsv", x,
-                                params["embed"]["embedding"].astype(x.dtype))
-        else:
-            logits = jnp.einsum("bsd,dv->bsv", x,
-                                params["lm_head"]["kernel"].astype(x.dtype))
-            if cfg.lm_head_bias:
-                logits = logits + params["lm_head"]["bias"].astype(logits.dtype)
+        with region_scope("head"):  # final norm + LM head projection
+            x = norm(x, params["final_norm"], cfg)
+            if cfg.tie_embeddings:
+                logits = jnp.einsum(
+                    "bsd,vd->bsv", x,
+                    params["embed"]["embedding"].astype(x.dtype))
+            else:
+                logits = jnp.einsum(
+                    "bsd,dv->bsv", x,
+                    params["lm_head"]["kernel"].astype(x.dtype))
+                if cfg.lm_head_bias:
+                    logits = logits + params["lm_head"]["bias"].astype(
+                        logits.dtype)
         return logits.astype(jnp.float32), new_cache, aux_total
 
     def apply(self, params: Params, input_ids: jnp.ndarray, **kw) -> jnp.ndarray:
@@ -426,24 +436,30 @@ class CausalLM:
             positions=batch.get("positions"),
             segment_ids=batch.get("segment_ids"), rng=rng,
             pld_theta=batch.get("pld_theta"), train=train)
-        if "labels" in batch:
-            labels = batch["labels"]
-            mask = batch.get("loss_mask", (labels >= 0).astype(jnp.float32))
-            labels = jnp.maximum(labels, 0)
-        else:
-            labels = jnp.concatenate(
-                [input_ids[:, 1:], jnp.zeros_like(input_ids[:, :1])], axis=1)
-            mask = jnp.concatenate(
-                [jnp.ones_like(input_ids[:, 1:], jnp.float32),
-                 jnp.zeros_like(input_ids[:, :1], jnp.float32)], axis=1)
-            if "loss_mask" in batch:
-                mask = mask * batch["loss_mask"]
-        logz = jax.scipy.special.logsumexp(logits, axis=-1)
-        gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-        nll = (logz - gold) * mask
-        denom = jnp.maximum(mask.sum(), 1.0)
-        lm_loss = nll.sum() / denom
-        total = lm_loss + self.config.aux_loss_coef * aux
+        from ..monitor.mfu import region_scope
+
+        with region_scope("loss"):  # softmax-xent MFU region
+            if "labels" in batch:
+                labels = batch["labels"]
+                mask = batch.get("loss_mask",
+                                 (labels >= 0).astype(jnp.float32))
+                labels = jnp.maximum(labels, 0)
+            else:
+                labels = jnp.concatenate(
+                    [input_ids[:, 1:], jnp.zeros_like(input_ids[:, :1])],
+                    axis=1)
+                mask = jnp.concatenate(
+                    [jnp.ones_like(input_ids[:, 1:], jnp.float32),
+                     jnp.zeros_like(input_ids[:, :1], jnp.float32)], axis=1)
+                if "loss_mask" in batch:
+                    mask = mask * batch["loss_mask"]
+            logz = jax.scipy.special.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(logits, labels[..., None],
+                                       axis=-1)[..., 0]
+            nll = (logz - gold) * mask
+            denom = jnp.maximum(mask.sum(), 1.0)
+            lm_loss = nll.sum() / denom
+            total = lm_loss + self.config.aux_loss_coef * aux
         metrics = {"lm_loss": lm_loss}
         if self.config.any_moe:
             metrics["moe_aux_loss"] = aux

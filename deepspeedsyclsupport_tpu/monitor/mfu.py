@@ -1,0 +1,585 @@
+"""MFU ledger core: region registry, HLO op→region map, trace join.
+
+The step-time attribution instrument ("Exploring the limits of Concurrency
+in ML Training on Google TPUs" does this per-phase attribution at pod
+scale): the engine wraps model phases in ``jax.named_scope("mfu.<region>")``
+labels, XLA propagates those labels into every compiled instruction's
+``metadata={op_name=...}``, and the profiler's Chrome-trace window carries
+one timed event per executed HLO op named by instruction. This module owns
+the three joins between those worlds:
+
+* :func:`build_opmap` — compiled-HLO text → ``{instruction: {region,
+  category}}`` (the named_scope metadata is read here; collectives override
+  to the ``collective`` region by opcode, since the partitioner inserts
+  them with no scope).
+* :func:`parse_trace` — ``trace.json.gz`` (Chrome-trace) → timed op events,
+  with truncation salvage: a torn gzip / half-written JSON from a killed
+  run yields everything parseable plus a ``truncated`` flag, never a crash
+  (the ``monitor/pod.py`` contract).
+* :func:`ledger` — the MFU ledger itself: achieved MFU, the gap waterfall
+  (hardware peak → roofline-achievable → measured), per-region
+  measured-vs-achievable with bound-by verdicts, top time sinks, and the
+  region-sum↔step-time reconciliation.
+
+DELIBERATELY STDLIB-ONLY: ``tools/mfu_report.py`` loads this file by path
+on jax-less login nodes (the ``pod.py`` contract — telemetry/analysis
+import FROM here, never the reverse). :func:`region_scope` is the one
+jax-touching helper and imports it lazily at call time.
+"""
+import gzip
+import json
+import os
+import re
+import zlib
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: Canonical attribution regions. The first block are SCOPE regions — model/
+#: engine code wraps phases in ``jax.named_scope("mfu.<name>")`` (via
+#: :func:`region_scope`) and dslint's ``undeclared-region`` rule rejects any
+#: label outside this set. The rest are DERIVED: ``collective`` is assigned
+#: by opcode (partitioner-inserted traffic carries no scope), ``host`` is
+#: the measured step-wall minus device-busy gap, ``other`` is every mapped
+#: op with no scope (norm chains, loss-scale bookkeeping, data movement).
+SCOPE_REGIONS = ("embed", "attn", "mlp", "head", "loss", "optimizer")
+DERIVED_REGIONS = ("collective", "other", "host")
+REGIONS = SCOPE_REGIONS + DERIVED_REGIONS
+
+#: named_scope label prefix — ``mfu.attn`` etc. Kept short and distinctive
+#: so the metadata regex can't false-positive on user scopes.
+SCOPE_PREFIX = "mfu."
+
+_REGION_RE = re.compile(r"mfu\.([A-Za-z0-9_]+)")
+
+#: HLO opcodes that are cross-device traffic regardless of scope (async
+#: halves included — time is attributed to whichever half the runtime bills)
+COLLECTIVE_OPCODES = frozenset({
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute", "collective-broadcast",
+    "all-reduce-start", "all-reduce-done", "all-gather-start",
+    "all-gather-done", "collective-permute-start",
+    "collective-permute-done", "send", "recv", "send-done", "recv-done",
+})
+
+#: coarse HLO category buckets for the by-category time split
+_CATEGORY = (
+    ("dot", ("dot", "convolution")),
+    ("collective", tuple(COLLECTIVE_OPCODES)),
+    ("fusion", ("fusion",)),
+    ("reduce", ("reduce", "reduce-window", "scatter", "gather")),
+    ("data-movement", ("copy", "transpose", "broadcast", "reshape",
+                       "bitcast", "concatenate", "slice", "dynamic-slice",
+                       "dynamic-update-slice", "pad", "iota")),
+    ("control", ("while", "conditional", "call", "tuple",
+                 "get-tuple-element", "parameter", "constant")),
+)
+
+
+def region_scope(name: str):
+    """``jax.named_scope`` for a declared MFU region — the ONE sanctioned
+    way model/engine code labels a phase (a bare ``named_scope("mfu.x")``
+    with a typo'd region would silently orphan its time; dslint's
+    ``undeclared-region`` rule rejects it, and this helper raises)."""
+    if name not in SCOPE_REGIONS:
+        raise ValueError(f"undeclared MFU region {name!r}; declared scope "
+                         f"regions: {SCOPE_REGIONS} (monitor/mfu.py)")
+    import jax  # lazy: this module must import stdlib-only
+
+    return jax.named_scope(SCOPE_PREFIX + name)
+
+
+def region_of(op_name: str) -> Optional[str]:
+    """Region encoded in an HLO ``metadata op_name`` path (e.g.
+    ``jit(f)/transpose(jvp(mfu.attn))/dot_general`` → ``attn``). The LAST
+    match wins: an inner scope refines an outer one. ``None`` = unscoped."""
+    found = _REGION_RE.findall(op_name or "")
+    if not found:
+        return None
+    name = found[-1]
+    return name if name in SCOPE_REGIONS else None
+
+
+def _category_of(opcode: str) -> str:
+    for cat, ops in _CATEGORY:
+        if opcode in ops:
+            return cat
+    return "other"
+
+
+# one HLO instruction definition: `  %name = type opcode(...), ...` or
+# `  ROOT %name = ...`. Names may carry dots/dashes (`dot.12`,
+# `subtract_exponential_fusion`); the result type may be a parenthesized
+# TUPLE with internal spaces — `(f32[8]{0}, s32[])` — which is exactly what
+# `while` loops and combined (variadic) all-reduces produce, i.e. the scan
+# trunk and the main grad-sync traffic this instrument exists to name. On
+# TPU the layouts inside the tuple carry one level of NESTED parens
+# (tiling annotations: `bf16[4096]{0:T(1024)}`), so the tuple branch must
+# admit them.
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%([\w.\-]+)\s*=\s*"
+    r"(?:\((?:[^()]|\([^()]*\))*\)|[^\s]+)\s+"
+    r"([a-z][\w\-]*)\(")
+_METADATA_RE = re.compile(r'metadata=\{[^}]*op_name="([^"]*)"')
+
+
+def build_opmap(hlo_text: str) -> Dict[str, Dict[str, str]]:
+    """Compiled-HLO text → ``{instruction_name: {"region", "category",
+    "opcode"}}`` for every instruction in every computation (trace events
+    are named by instruction; names are unique module-wide).
+
+    Region precedence: collective opcode > ``mfu.<region>`` scope in the
+    op_name metadata > ``other``. Trivial bookkeeping opcodes (parameter/
+    constant/tuple plumbing) are skipped — they never carry measured time.
+    """
+    out: Dict[str, Dict[str, str]] = {}
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        name, opcode = m.group(1), m.group(2)
+        if opcode in ("parameter", "constant", "tuple", "get-tuple-element"):
+            continue
+        if opcode in COLLECTIVE_OPCODES:
+            region = "collective"
+        else:
+            meta = _METADATA_RE.search(line)
+            region = region_of(meta.group(1)) if meta else None
+            region = region or "other"
+        out[name] = {"region": region, "category": _category_of(opcode),
+                     "opcode": opcode}
+    return out
+
+
+# ------------------------------------------------------------------ trace IO
+def _salvage_events(text: str) -> Tuple[List[Dict[str, Any]], bool]:
+    """Chrome-trace JSON salvage: when ``json.loads`` fails (torn tail),
+    walk the ``traceEvents`` array with a brace counter and keep every
+    COMPLETE event object. Returns (events, salvaged_flag)."""
+    try:
+        d = json.loads(text)
+        return list(d.get("traceEvents", [])), False
+    except ValueError:
+        pass
+    events: List[Dict[str, Any]] = []
+    idx = text.find('"traceEvents"')
+    if idx < 0:
+        return events, True
+    idx = text.find("[", idx)
+    if idx < 0:
+        return events, True
+    depth = 0
+    start = None
+    in_str = False
+    esc = False
+    for i in range(idx + 1, len(text)):
+        c = text[i]
+        if in_str:
+            if esc:
+                esc = False
+            elif c == "\\":
+                esc = True
+            elif c == '"':
+                in_str = False
+            continue
+        if c == '"':
+            in_str = True
+        elif c == "{":
+            if depth == 0:
+                start = i
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            if depth == 0 and start is not None:
+                try:
+                    events.append(json.loads(text[start:i + 1]))
+                except ValueError:
+                    pass
+                start = None
+        elif c == "]" and depth == 0:
+            break
+    return events, True
+
+
+def parse_trace(path: str) -> Tuple[List[Dict[str, Any]], Dict[str, Any]]:
+    """Load one Chrome-trace file (``.json`` or ``.json.gz``) with
+    truncation salvage. Returns ``(duration_events, meta)`` where
+    duration_events are the ``"ph" == "X"`` records and ``meta`` carries
+    ``{"truncated": bool, "n_events": int, "path": str}``. A torn gzip
+    stream (killed mid-write) decompresses to its last whole deflate block
+    and the JSON salvage keeps every complete event — flagged, not fatal."""
+    truncated = False
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError:
+        return [], {"truncated": True, "n_events": 0, "path": path}
+    if path.endswith(".gz") or raw[:2] == b"\x1f\x8b":
+        try:
+            text = gzip.decompress(raw).decode("utf-8", "replace")
+        except (OSError, EOFError, zlib.error):
+            # torn gzip: stream-decompress whatever whole blocks exist
+            d = zlib.decompressobj(wbits=31)
+            try:
+                text = d.decompress(raw).decode("utf-8", "replace")
+            except zlib.error:
+                text = ""
+            truncated = True
+    else:
+        text = raw.decode("utf-8", "replace")
+    events, salvaged = _salvage_events(text)
+    truncated = truncated or salvaged
+    dur_events = [e for e in events
+                  if e.get("ph") == "X" and "ts" in e and "dur" in e]
+    return dur_events, {"truncated": truncated, "n_events": len(dur_events),
+                        "path": path}
+
+
+def find_trace(root: str) -> Optional[str]:
+    """Newest ``*.trace.json.gz`` (or ``trace.json``) under ``root`` — the
+    ``jax.profiler`` layout is ``<root>/plugins/profile/<run>/<host>.trace
+    .json.gz``; a bare file path passes through."""
+    if os.path.isfile(root):
+        return root
+    hits: List[str] = []
+    for dirpath, _dirnames, files in os.walk(root):
+        for f in files:
+            if f.endswith((".trace.json.gz", "trace.json.gz", "trace.json")):
+                hits.append(os.path.join(dirpath, f))
+    return max(hits, key=lambda p: os.path.getmtime(p)) if hits else None
+
+
+# ---------------------------------------------------------------- measurement
+def _union_us(intervals: Iterable[Tuple[float, float]]) -> float:
+    """Total length of the union of [start, end) intervals (µs)."""
+    ivs = sorted(intervals)
+    total = 0.0
+    cur_s = cur_e = None
+    for s, e in ivs:
+        if cur_e is None or s > cur_e:
+            if cur_e is not None:
+                total += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    if cur_e is not None:
+        total += cur_e - cur_s
+    return total
+
+
+def _self_segments(events: List[Dict[str, Any]],
+                   opmap: Dict[str, Dict[str, str]]
+                   ) -> List[Tuple[float, float, str, str]]:
+    """Flatten one THREAD's (properly nested) op events into disjoint
+    ``(start, end, region, category)`` self-time segments: a ``while`` op's
+    event covers its whole loop while every body op is ALSO recorded inside
+    it — a plain duration sum double-counts that containment (observed
+    1.7× on the CPU executor). Each event owns only the parts of its span
+    not covered by a nested event."""
+    es = sorted((e for e in events), key=lambda e: (e["ts"], -e["dur"]))
+    segs: List[Tuple[float, float, str, str]] = []
+    # stack entries: [end, cursor, region, category]; cursor = where this
+    # event's uncovered span resumes after the current child
+    stack: List[List[Any]] = []
+
+    def pop_to(ts: float) -> None:
+        while stack and stack[-1][0] <= ts:
+            end, cursor, region, cat = stack.pop()
+            if end > cursor:
+                segs.append((cursor, end, region, cat))
+            if stack:
+                stack[-1][1] = max(stack[-1][1], end)
+
+    for e in es:
+        ts = float(e["ts"])
+        end = ts + float(e["dur"])
+        info = opmap[str(e["name"])]
+        pop_to(ts)
+        if stack and stack[-1][1] < ts:
+            # parent's uncovered span up to this child
+            segs.append((stack[-1][1], ts, stack[-1][2], stack[-1][3]))
+            stack[-1][1] = ts
+        stack.append([end, ts, info["region"], info["category"]])
+    pop_to(float("inf"))
+    return segs
+
+
+def measure_regions(events: Sequence[Dict[str, Any]],
+                    opmap: Dict[str, Dict[str, str]],
+                    steps: int = 1) -> Dict[str, Any]:
+    """Join timed trace events against the opmap into per-region and
+    per-HLO-category seconds (per step).
+
+    Attribution is WALL-CLOCK-exact, not duration-sum: per thread, nested
+    events flatten to self-time segments (:func:`_self_segments`); across
+    threads, every instant of the mapped-op union timeline is split evenly
+    among the threads busy at that instant (the executor genuinely runs
+    independent ops concurrently — billing both in full would overcount).
+    So ``sum(regions) == mapped-op union`` by construction, and the ledger
+    reconciliation catches the one thing that can still go missing:
+    op events whose name is NOT in the opmap (``orphan_s``) — exactly what
+    a typo'd/missing scope or a stale opmap produces.
+
+    ``device_busy_s`` is the union over ALL op events (an event counts as
+    an op when its name is in the opmap or it carries an ``hlo_op`` arg),
+    mapped or not."""
+    steps = max(1, int(steps))
+    by_thread: Dict[Tuple[Any, Any], List[Dict[str, Any]]] = {}
+    all_intervals: List[Tuple[float, float]] = []
+    n_mapped = n_orphan = 0
+    for e in events:
+        name = str(e.get("name", ""))
+        mapped = name in opmap
+        is_op = mapped or "hlo_op" in (e.get("args") or {})
+        if not is_op:
+            continue
+        ts = float(e["ts"])
+        all_intervals.append((ts, ts + float(e["dur"])))
+        if not mapped:
+            n_orphan += 1
+            continue
+        n_mapped += 1
+        by_thread.setdefault((e.get("pid"), e.get("tid")), []).append(e)
+
+    # per-thread disjoint self segments → global even-split sweep
+    threads = [
+        _self_segments(es, opmap) for es in by_thread.values()]
+    points: List[Tuple[float, int, int, str, str]] = []
+    for ti, segs in enumerate(threads):
+        for s, e, region, cat in segs:
+            points.append((s, 1, ti, region, cat))
+            points.append((e, -1, ti, region, cat))
+    # closes (-1) before opens (+1) at equal t: per-thread segments are
+    # disjoint, so a segment ending exactly where the next begins must
+    # release the thread slot before the successor claims it
+    points.sort(key=lambda p: (p[0], p[1]))
+    regions: Dict[str, float] = {}
+    categories: Dict[str, float] = {}
+    active: Dict[int, Tuple[str, str]] = {}
+    prev = None
+    mapped_union = 0.0
+    for t, kind, ti, region, cat in points:
+        if prev is not None and active and t > prev:
+            share = (t - prev) / len(active)
+            mapped_union += t - prev
+            for r, c in active.values():
+                regions[r] = regions.get(r, 0.0) + share
+                categories[c] = categories.get(c, 0.0) + share
+        prev = t
+        if kind == 1:
+            active[ti] = (region, cat)
+        else:
+            active.pop(ti, None)
+
+    union_all = _union_us(all_intervals)
+    return {
+        "regions": {r: s / 1e6 / steps for r, s in regions.items()},
+        "categories": {c: s / 1e6 / steps for c, s in categories.items()},
+        "device_busy_s": union_all / 1e6 / steps,
+        "mapped_union_s": mapped_union / 1e6 / steps,
+        "orphan_s": max(0.0, union_all - mapped_union) / 1e6 / steps,
+        "n_mapped": n_mapped,
+        "n_unmapped": n_orphan,
+        "steps": steps,
+    }
+
+
+# -------------------------------------------------------------------- ledger
+#: serialized-ledger schema (validated by tests and the report tool)
+MFU_LEDGER_KEYS = ("schema_version", "step_s", "device_busy_s", "host_s",
+                   "orphan_s", "model_flops", "peak_flops", "achieved_mfu",
+                   "roofline_mfu", "waterfall", "regions", "top_sinks",
+                   "reconciliation", "truncated_trace", "device")
+
+
+def ledger(roofline: Optional[Dict[str, Any]],
+           measured: Dict[str, Any],
+           step_s: float,
+           truncated_trace: bool = False) -> Dict[str, Any]:
+    """The join: analytic roofline table + measured per-region times + the
+    measured clean-step wall → the MFU ledger.
+
+    ``roofline`` is ``analysis/roofline.py``'s serialized table
+    (``{"device", "spec": {"peak_flops", ...}, "regions": {r: {"flops",
+    "hbm_bytes", "comm_bytes", "achievable_s", "bound_by"}},
+    "total_flops", "total_achievable_s"}``) — optional: without it the
+    ledger is measured-only (no waterfall/verdicts), which is what a bare
+    trace on a login node can still say.
+
+    Waterfall semantics: ``hardware_peak`` is the time the step's analytic
+    FLOPs would take at 100% MFU; ``roofline_achievable`` adds each
+    region's binding resource (compute, HBM bytes, or comm bytes — the
+    per-region max, summed, an optimistic no-overlap-needed floor);
+    ``measured`` is the observed clean-step wall. Each level carries the
+    MFU the step WOULD run at if time stopped there, so gap = distance
+    between adjacent bars and names whether the model (peak→roofline) or
+    the execution (roofline→measured) loses the time.
+
+    Reconciliation: region times (``host`` = step wall − device-busy union,
+    included) must re-sum to the step wall. Region attribution is
+    wall-exact (``measure_regions``), so the frac moves away from 1.0 for
+    exactly two reasons: ORPHANED op time (measured ops whose name the
+    opmap doesn't know — a typo'd scope, a stale opmap) pushes it low, and
+    a window that measured MORE than the claimed step (two steps fused,
+    wrong window) pushes it high."""
+    step_s = max(float(step_s), 1e-12)
+    meas_regions = dict(measured.get("regions", {}))
+    device_busy = float(measured.get("device_busy_s", 0.0))
+    host_s = max(0.0, step_s - device_busy)
+    meas_regions["host"] = host_s
+    spec = (roofline or {}).get("spec", {})
+    peak = float(spec.get("peak_flops", 0.0))
+    total_flops = float((roofline or {}).get("total_flops", 0.0))
+    roof_regions = (roofline or {}).get("regions", {})
+    roof_total_s = float((roofline or {}).get("total_achievable_s", 0.0))
+
+    regions_out: Dict[str, Dict[str, Any]] = {}
+    for name in sorted(set(meas_regions) | set(roof_regions)):
+        meas = float(meas_regions.get(name, 0.0))
+        roof = roof_regions.get(name, {})
+        achievable = float(roof.get("achievable_s", 0.0))
+        regions_out[name] = {
+            "measured_s": meas,
+            "frac": meas / step_s,
+            "achievable_s": achievable,
+            # measured/achievable: how far this region runs above its own
+            # roofline floor (1.0 = at the roofline; 50 = 50x headroom)
+            "headroom": (meas / achievable) if achievable > 0 else None,
+            "bound_by": roof.get("bound_by"),
+            "flops": float(roof.get("flops", 0.0)),
+            "hbm_bytes": float(roof.get("hbm_bytes", 0.0)),
+            "comm_bytes": float(roof.get("comm_bytes", 0.0)),
+        }
+
+    achieved_mfu = (total_flops / (step_s * peak)) if peak > 0 else None
+    roofline_mfu = (total_flops / (roof_total_s * peak)
+                    if peak > 0 and roof_total_s > 0 else None)
+    waterfall = []
+    if peak > 0 and total_flops > 0:
+        peak_s = total_flops / peak
+        waterfall = [
+            {"level": "hardware_peak", "s": peak_s, "mfu": 1.0},
+            {"level": "roofline_achievable", "s": roof_total_s,
+             "mfu": roofline_mfu},
+            {"level": "measured", "s": step_s, "mfu": achieved_mfu},
+        ]
+    sinks = sorted((r for r in regions_out if r != "host"),
+                   key=lambda r: -regions_out[r]["measured_s"])
+    region_sum = sum(v["measured_s"] for v in regions_out.values())
+    return {
+        "schema_version": 1,
+        "step_s": step_s,
+        "device_busy_s": device_busy,
+        "host_s": host_s,
+        "orphan_s": float(measured.get("orphan_s", 0.0)),
+        "model_flops": total_flops,
+        "peak_flops": peak,
+        "achieved_mfu": achieved_mfu,
+        "roofline_mfu": roofline_mfu,
+        "waterfall": waterfall,
+        "regions": regions_out,
+        "top_sinks": sinks[:5],
+        "reconciliation": {"region_sum_s": region_sum, "step_s": step_s,
+                           "frac": region_sum / step_s},
+        "truncated_trace": bool(truncated_trace),
+        "device": (roofline or {}).get("device"),
+        "categories": dict(measured.get("categories", {})),
+    }
+
+
+def validate_ledger(d: Dict[str, Any]) -> List[str]:
+    """Missing-key check against :data:`MFU_LEDGER_KEYS` (schema v1)."""
+    return [k for k in MFU_LEDGER_KEYS if k not in d]
+
+
+def ledger_events(led: Dict[str, Any], step: int = 0
+                  ) -> List[Tuple[str, Any, int]]:
+    """Strict-registry ``MFU/*`` scalar events from a ledger (dot-tail
+    region members — ``MFU/region.attn`` — so the static event-name lint
+    resolves every literal)."""
+    ev: List[Tuple[str, Any, int]] = [
+        ("MFU/step_s", led["step_s"], step),
+        ("MFU/device_busy_s", led["device_busy_s"], step),
+    ]
+    if led.get("achieved_mfu") is not None:
+        ev.append(("MFU/achieved", led["achieved_mfu"], step))
+    if led.get("roofline_mfu") is not None:
+        ev.append(("MFU/roofline_bound", led["roofline_mfu"], step))
+    if led.get("model_flops"):
+        ev.append(("MFU/model_tflops", led["model_flops"] / 1e12, step))
+    for name in REGIONS:
+        r = led["regions"].get(name)
+        if r is not None:
+            # members enumerated from REGIONS, each declared exactly in
+            # EVENT_NAMES — the base below never ships a typo'd member
+            ev.append((f"MFU/region.{name}",  # dslint: allow(undeclared-event-name) registry-enumerated member builder
+                       r["measured_s"], step))
+    return ev
+
+
+# -------------------------------------------------------------------- render
+def _fmt_s(sec: Optional[float]) -> str:
+    if sec is None:
+        return "     -"
+    if sec < 1e-3:
+        return f"{sec * 1e6:.0f}us"
+    return f"{sec * 1000:.1f}ms" if sec < 1.0 else f"{sec:.2f}s"
+
+
+def _fmt_pct(x: Optional[float]) -> str:
+    return "    -" if x is None else f"{100.0 * x:5.1f}%"
+
+
+def render_ledger(led: Dict[str, Any], top: int = 10) -> str:
+    """Human-readable ledger: waterfall, per-region table, top sinks."""
+    lines = ["MFU ledger" + (f" — device {led['device']}"
+                             if led.get("device") else "")]
+    if led.get("truncated_trace"):
+        lines.append("  WARNING: trace window was truncated — measured "
+                     "times are a lower bound")
+    if led.get("achieved_mfu") is not None:
+        lines.append(f"  achieved MFU: {_fmt_pct(led['achieved_mfu'])} "
+                     f"({led['model_flops'] / 1e12:.3f} TFLOP analytic step "
+                     f"in {_fmt_s(led['step_s'])})")
+    if led.get("waterfall"):
+        lines.append("  gap waterfall (where would the step be if time "
+                     "stopped at each level):")
+        for w in led["waterfall"]:
+            lines.append(f"    {w['level']:<22}{_fmt_s(w['s']):>10}  "
+                         f"MFU {_fmt_pct(w.get('mfu'))}")
+    regions = led.get("regions", {})
+    if regions:
+        lines.append(f"  {'region':<12}{'measured':>10}{'share':>8}"
+                     f"{'roofline':>10}{'headroom':>10}  bound by")
+        order = sorted(regions, key=lambda r: -regions[r]["measured_s"])
+        for name in order:
+            r = regions[name]
+            if r["measured_s"] <= 0 and r["achievable_s"] <= 0:
+                continue
+            head = (f"{r['headroom']:8.1f}x" if r.get("headroom")
+                    else "       -")
+            lines.append(
+                f"  {name:<12}{_fmt_s(r['measured_s']):>10}"
+                f"{_fmt_pct(r['frac']):>8}{_fmt_s(r['achievable_s']):>10}"
+                f"{head:>10}  {r.get('bound_by') or '-'}")
+    sinks = led.get("top_sinks", [])[:top]
+    if sinks:
+        lines.append("  top sinks: " + ", ".join(
+            f"{s} ({_fmt_s(regions[s]['measured_s'])})" for s in sinks))
+    rec = led.get("reconciliation", {})
+    if rec:
+        frac = rec.get("frac", 0.0)
+        flag = "" if abs(frac - 1.0) <= 0.05 else \
+            "  <-- regions do not re-sum to the step (orphaned ops or " \
+            "wrong window)"
+        lines.append(f"  reconciliation: region sum "
+                     f"{_fmt_s(rec.get('region_sum_s'))} vs step "
+                     f"{_fmt_s(rec.get('step_s'))} "
+                     f"({_fmt_pct(frac)} accounted){flag}")
+        if led.get("orphan_s"):
+            lines.append(f"  orphaned op time (not in opmap): "
+                         f"{_fmt_s(led['orphan_s'])}")
+    cats = led.get("categories", {})
+    if cats:
+        order = sorted(cats, key=lambda c: -cats[c])
+        lines.append("  by HLO category: " + ", ".join(
+            f"{c}={_fmt_s(cats[c])}" for c in order if cats[c] > 0))
+    return "\n".join(lines)

@@ -50,6 +50,10 @@ from ..utils.logging import logger
 # offline CLIs load THAT file standalone on jax-less nodes, so the import
 # must point this way — pod never imports telemetry)
 from .pod import DURATION_BUCKETS_S, histogram_quantile  # noqa: F401
+# region registry for the MFU/* event family lives in the stdlib-only mfu
+# module (same direction as the pod import above: the offline CLIs load
+# THAT file standalone — mfu.py never imports telemetry)
+from .mfu import REGIONS as MFU_REGIONS
 
 Event = Tuple[str, Any, int]
 
@@ -162,7 +166,17 @@ EVENT_NAMES = frozenset(
      "Fleet/routed", "Fleet/shed", "Fleet/completed", "Fleet/affinity_hits",
      "Fleet/failover.deaths", "Fleet/failover.replays",
      "Fleet/failover.replay_sheds",
-     "Fleet/replicas_ready", "Fleet/inflight", "Fleet/routed_ttft_s"}
+     "Fleet/replicas_ready", "Fleet/inflight", "Fleet/routed_ttft_s",
+     # MFU ledger (monitor/mfu.py + analysis/roofline.py; docs/
+     # observability.md "MFU ledger"): achieved MFU vs the roofline bound,
+     # the measured clean-step wall + device-busy split, and analytic step
+     # FLOPs. Per-region measured seconds ride the dot-tail convention
+     # (MFU/region.attn) and are enumerated from the region registry below
+     # so the static event-name lint resolves every literal — a typo'd
+     # region name fails dslint, not strict mode at runtime.
+     "MFU/achieved", "MFU/roofline_bound", "MFU/step_s",
+     "MFU/device_busy_s", "MFU/model_tflops"}
+    | {f"MFU/region.{r}" for r in MFU_REGIONS}  # dslint: allow(undeclared-event-name) registry-enumerated member builder
     | {f"Serve/{h}/{q}" for h in ("ttft_s", "itl_s",
                                   "recovery.time_to_recover_s")
        for q in ("p50", "p95", "p99")}

@@ -36,6 +36,12 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# modern spelling with a version-tolerant fallback (jax<=0.4.x names the
+# same dataclass TPUCompilerParams) — without it every kernel call dies on
+# an AttributeError before reaching the TPU/interpret path at all
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    getattr(pltpu, "TPUCompilerParams")
+
 NEG_INF = -1e30
 _LANES = 128
 
@@ -430,7 +436,7 @@ def _dbias_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab,
                                lambda bi, hi, i, j, r: (bi, hi, i, j)),
         out_shape=jax.ShapeDtypeStruct((bb, hb, sq, skv), jnp.float32),
         scratch_shapes=[pltpu.VMEM((block_q, block_k), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "parallel", "arbitrary")),
         interpret=interpret,
@@ -519,7 +525,7 @@ def _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, ab, bias, kbias,
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -577,7 +583,7 @@ def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab,
         out_specs=dq_out_specs,
         out_shape=dq_out_shape,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -625,7 +631,7 @@ def _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k, pos_q, pos_k, ab,
                    jax.ShapeDtypeStruct((b, h, skv, d), jnp.float32)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -687,6 +693,61 @@ def _make_flash(head_dim, causal, skip_offset, q_len, kv_len, block_q,
     return f
 
 
+@functools.lru_cache(maxsize=None)
+def _make_flash_lse(head_dim, causal, skip_offset, q_len, kv_len, block_q,
+                    block_k, use_alibi, window, has_bias, has_kbias,
+                    has_layout, interpret):
+    """Variant returning ``(o, lse)`` with BOTH differentiable — the block
+    combiner ring attention needs (per-block outputs merge by logsumexp,
+    so the final output depends on each block's lse). The backward is the
+    standard flash backward with one substitution: with an lse cotangent
+    ``dlse``, ``∂lse_i/∂S_ij = P_ij`` adds ``dlse_i·P_ij`` to ``dS``, i.e.
+    ``dS_ij = P_ij(do_i·v_j − (δ_i − dlse_i))`` — so the kernels run
+    unchanged with ``delta − dlse`` in delta's slot (dv has no lse term:
+    ``∂lse/∂V = 0``)."""
+    call_kw = dict(scale=1.0 / np.sqrt(head_dim), causal=causal,
+                   skip_offset=skip_offset, q_len=q_len, kv_len=kv_len,
+                   block_q=block_q, block_k=block_k, use_alibi=use_alibi,
+                   window=window, interpret=interpret)
+
+    def split(bias, kbias, layout):
+        return (bias if has_bias else None, kbias if has_kbias else None,
+                layout if has_layout else None)
+
+    @jax.custom_vjp
+    def f(q, k, v, seg_q, seg_k, pos_q, pos_k, ab, bias, kbias, layout):
+        return _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, ab,
+                         *split(bias, kbias, layout), **call_kw)
+
+    def f_fwd(q, k, v, seg_q, seg_k, pos_q, pos_k, ab, bias, kbias, layout):
+        o, lse = _fwd_call(q, k, v, seg_q, seg_k, pos_q, pos_k, ab,
+                           *split(bias, kbias, layout), **call_kw)
+        return (o, lse), (q, k, v, seg_q, seg_k, pos_q, pos_k, ab, bias,
+                          kbias, layout, o, lse)
+
+    def f_bwd(res, cts):
+        (q, k, v, seg_q, seg_k, pos_q, pos_k, ab, bias, kbias, layout, o,
+         lse) = res
+        do, dlse = cts
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1, keepdims=True)            # [B,H,Sq,1]
+        delta = delta - dlse.astype(jnp.float32)
+        dq, dk, dv, dbias = _bwd_call(q, k, v, do, lse, delta, seg_q, seg_k,
+                                      pos_q, pos_k, ab,
+                                      *split(bias, kbias, layout),
+                                      **call_kw)
+        zero = lambda x: np.zeros(x.shape, jax.dtypes.float0)
+        dbias = (dbias.astype(bias.dtype) if dbias is not None
+                 else jnp.zeros_like(bias))
+        return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+                zero(seg_q), zero(seg_k), zero(pos_q), zero(pos_k),
+                jnp.zeros_like(ab), dbias, jnp.zeros_like(kbias),
+                zero(layout))
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
 # -------------------------------------------------------------------- public
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True,
@@ -700,7 +761,8 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     k_bias: Optional[jnp.ndarray] = None,
                     block_layout: Optional[jnp.ndarray] = None,
                     block_q: int = 512, block_k: int = 512,
-                    interpret: Optional[bool] = None) -> jnp.ndarray:
+                    interpret: Optional[bool] = None,
+                    return_lse: bool = False) -> jnp.ndarray:
     """Flash attention over ``q [B,Sq,H,D]``, ``k/v [B,Skv,KVH,D]``.
 
     Differentiable (custom fwd/bwd Pallas kernels); GQA when ``KVH < H``;
@@ -721,6 +783,11 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     {1, H} — the SparsityConfig layout contract (see
     ``ops/sparse_attention.py``). Returns ``[B,Sq,H,D]`` in q's dtype.
     Off-TPU runs in interpret mode.
+
+    ``return_lse=True`` additionally returns the per-row logsumexp
+    ``[B,Sq,H]`` fp32 (``m + log l``; ``-1e30`` for a fully-masked row) —
+    differentiable alongside the output, which is what the ring-attention
+    block combiner needs to merge per-block partial results exactly.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -831,15 +898,20 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         layout_a = jnp.asarray(block_layout, jnp.int32)
     else:
         layout_a = jnp.zeros((1, 1, 1), jnp.int32)  # unused placeholder
-    fn = _make_flash(int(d), bool(causal),
-                     None if skip_offset is None else int(skip_offset),
-                     int(sq), int(skv), int(block_q), int(block_k),
-                     alibi is not None,
-                     None if window is None else int(window),
-                     bias is not None, k_bias is not None,
-                     block_layout is not None,
-                     bool(interpret))
+    maker = _make_flash_lse if return_lse else _make_flash
+    fn = maker(int(d), bool(causal),
+               None if skip_offset is None else int(skip_offset),
+               int(sq), int(skv), int(block_q), int(block_k),
+               alibi is not None,
+               None if window is None else int(window),
+               bias is not None, k_bias is not None,
+               block_layout is not None,
+               bool(interpret))
     out = fn(qt, kt, vt, seg_q, seg_k, pos_q, pos_k, ab, bias_p,
              kbias_p, layout_a)                           # [B,H,Sq_p,D_p]
+    if return_lse:
+        out, lse = out
+        out = jnp.transpose(out[:, :, :sq, :d], (0, 2, 1, 3))
+        return out, jnp.transpose(lse[:, :, :sq, 0], (0, 2, 1))
     out = out[:, :, :sq, :d]
     return jnp.transpose(out, (0, 2, 1, 3))

@@ -160,6 +160,53 @@ def _route(front, back, plan_colors, axis_name, idx):
 
 
 # ------------------------------------------------------------------- bodies
+def _ring_body_flash(q, k, v, axis_name: str, n: int, causal: bool):
+    """Pallas-flash inner ring (the ``attn_impl`` wiring the ROADMAP names:
+    ulysses dispatches its local attention to the flash kernel; this is the
+    ring's equivalent). Each incoming KV block is ONE flash-kernel call with
+    explicit absolute positions (cross-block causality lives in position
+    space) returning ``(out, lse)``; blocks merge in lse space — the same
+    streaming-softmax algebra as the inline path, with the inner O(C²) loop
+    on the MXU instead of jnp.
+
+    A fully-masked (future) block reports ``lse = -1e30`` per row; the
+    guard zeroes its weight — without it ``exp(-1e30 − (-1e30)) == 1``
+    would credit phantom mass. No zigzag variant: the static tile-skip
+    re-layout is an inline-path optimization; here the kernel masks
+    in-block and the A/B prices exactly that trade."""
+    idx = lax.axis_index(axis_name)
+    b, c, h, d = q.shape
+    q_pos = jnp.broadcast_to((idx * c + jnp.arange(c))[None, :], (b, c))
+    acc = jnp.zeros((b, c, h, d), jnp.float32)
+    m = jnp.full((b, c, h), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, c, h), jnp.float32)
+    k_t, v_t = k, v
+    from ..ops.flash_attention import flash_attention
+
+    for t in range(n):  # unrolled — same rationale as the inline bodies
+        src_blk = (idx - t) % n
+        kv_pos = jnp.broadcast_to((src_blk * c + jnp.arange(c))[None, :],
+                                  (b, c))
+        o_b, lse_b = flash_attention(
+            q, k_t, v_t, causal=causal,
+            q_positions=q_pos if causal else None,
+            kv_positions=kv_pos if causal else None,
+            return_lse=True)
+        live = lse_b > NEG_INF / 2  # [B,C,H] per-row: block contributes
+        m_new = jnp.where(live, jnp.maximum(m, lse_b), m)
+        corr = jnp.exp(m - m_new)
+        w = jnp.where(live, jnp.exp(lse_b - m_new), 0.0)
+        acc = acc * corr[..., None] + o_b.astype(jnp.float32) * w[..., None]
+        l = l * corr + w
+        m = m_new
+        if t < n - 1:
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            k_t = lax.ppermute(k_t, axis_name, perm)
+            v_t = lax.ppermute(v_t, axis_name, perm)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
 def _ring_body_full(q, k, v, axis_name: str, causal: bool):
     """Naive n-block ring (non-causal, or causal fallback for odd chunks).
     shard_map body. q/k/v local: [B, C, H, D] (C = S / ring_size)."""
@@ -291,19 +338,38 @@ def _ring_body_zigzag(q, k, v, axis_name: str, n: int):
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    causal: bool = True,
                    axis_name: str = "seq",
-                   topology=None) -> jnp.ndarray:
-    """q/k/v: [B, S, H|KVH, D] logically global, sequence-sharded over ``seq``."""
+                   topology=None,
+                   inner: Optional[str] = None) -> jnp.ndarray:
+    """q/k/v: [B, S, H|KVH, D] logically global, sequence-sharded over ``seq``.
+
+    ``inner`` selects the per-block attention implementation — the
+    ``attn_impl`` seam ulysses already has: ``"flash"`` runs each KV block
+    through the Pallas kernel (lse-combined across blocks, exact),
+    ``"xla"`` keeps the inline online-softmax bodies (zigzag-balanced when
+    causal), ``None`` auto-selects flash on TPU. Reachable from model
+    configs as ``attn_impl="ring:flash"`` / ``"ring:xla"``."""
     from ..comm.topology import get_world_topology
 
     topo = topology or get_world_topology()
     n = topo.axis_sizes.get(axis_name, 1) if topo is not None else 1
+    if inner is None:
+        inner = "flash" if jax.default_backend() == "tpu" else "xla"
+    if inner not in ("flash", "xla"):
+        raise ValueError(f"unknown ring inner impl {inner!r} (flash | xla)")
     if n <= 1:
+        if inner == "flash":
+            from ..ops.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=causal)
         from ..models.layers import reference_attention
 
         return reference_attention(q, k, v, causal=causal)
 
     c = q.shape[1] // n  # local chunk per device
-    if causal and c % 2 == 0 and c >= 2:
+    if inner == "flash":
+        body = partial(_ring_body_flash, axis_name=axis_name, n=n,
+                       causal=causal)
+    elif causal and c % 2 == 0 and c >= 2:
         body = partial(_ring_body_zigzag, axis_name=axis_name, n=n)
     else:
         body = partial(_ring_body_full, axis_name=axis_name, causal=causal)

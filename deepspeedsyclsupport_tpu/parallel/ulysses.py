@@ -34,6 +34,9 @@ def _local_attention(q, k, v, causal, segment_ids, inner):
     """Per-device attention over the full sequence with a head slice."""
     if inner is None:
         inner = "flash" if jax.default_backend() == "tpu" else "xla"
+    if inner not in ("flash", "xla"):
+        raise ValueError(f"unknown ulysses inner impl {inner!r} "
+                         f"(flash | xla)")
     if inner == "flash":
         from ..ops.flash_attention import flash_attention
 

@@ -89,6 +89,29 @@ _CHEAP = {"add", "sub", "mul", "div", "max", "min", "neg", "abs", "exp", "log",
           "square", "reciprocal", "logaddexp", "atan2", "expm1", "log1p"}
 
 
+def eqn_flops(eqn) -> Optional[float]:
+    """Analytic FLOPs of ONE leaf equation, or ``None`` for primitives
+    this model doesn't cost (data movement, control flow). Shared by the
+    per-primitive totals below and the per-region roofline partition
+    (``analysis/roofline.py``) so both count with identical rules."""
+    name = eqn.primitive.name
+    if name == "dot_general":
+        return _dot_flops(eqn)
+    if name == "conv_general_dilated":
+        out = eqn.outvars[0].aval
+        rhs = eqn.invars[1].aval
+        dn = eqn.params["dimension_numbers"]
+        k_spatial = np.prod([rhs.shape[d] for d in dn.rhs_spec[2:]],
+                            dtype=float)
+        cin = rhs.shape[dn.rhs_spec[1]]
+        return 2.0 * np.prod(out.shape, dtype=float) * k_spatial * cin
+    if name in _REDUCTIONS:
+        return _reduction_flops(eqn)
+    if name in _CHEAP:
+        return _elementwise_flops(eqn)
+    return None
+
+
 def count_jaxpr_flops(jaxpr, by: Optional[Dict[str, float]] = None,
                       mult: float = 1.0) -> Dict[str, float]:
     """Per-primitive FLOP count over the recursive equation stream
@@ -99,22 +122,10 @@ def count_jaxpr_flops(jaxpr, by: Optional[Dict[str, float]] = None,
 
     by = by if by is not None else {}
     for eqn, eq_mult in iter_eqns(jaxpr, mult):
-        name = eqn.primitive.name
-        if name == "dot_general":
-            by[name] = by.get(name, 0.0) + _dot_flops(eqn) * eq_mult
-        elif name == "conv_general_dilated":
-            out = eqn.outvars[0].aval
-            rhs = eqn.invars[1].aval
-            dn = eqn.params["dimension_numbers"]
-            k_spatial = np.prod([rhs.shape[d] for d in dn.rhs_spec[2:]],
-                                dtype=float)
-            cin = rhs.shape[dn.rhs_spec[1]]
-            f = 2.0 * np.prod(out.shape, dtype=float) * k_spatial * cin
+        f = eqn_flops(eqn)
+        if f is not None:
+            name = eqn.primitive.name
             by[name] = by.get(name, 0.0) + f * eq_mult
-        elif name in _REDUCTIONS:
-            by[name] = by.get(name, 0.0) + _reduction_flops(eqn) * eq_mult
-        elif name in _CHEAP:
-            by[name] = by.get(name, 0.0) + _elementwise_flops(eqn) * eq_mult
     return by
 
 

@@ -375,6 +375,13 @@ class TelemetryConfig:
     trace_start_step: Optional[int] = None
     trace_num_steps: int = 3
     trace_dir: Optional[str] = None
+    # MFU ledger (monitor/mfu.py + analysis/roofline.py): auto-capture ONE
+    # jax.profiler window around a clean (non-compiling) step — earliest at
+    # mfu_step — and join it against the roofline partition via
+    # Engine.mfu_ledger(). The window costs one synced step; everything
+    # else is offline.
+    mfu_enabled: bool = False
+    mfu_step: int = 3
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "TelemetryConfig":
@@ -382,6 +389,13 @@ class TelemetryConfig:
         tr = dict(d.get("trace", {}))
         tf = dict(d.get("textfile", {}))
         wd = dict(d.get("watchdog", {}))
+        mfu = dict(d.get("mfu", {}))
+        mfu_step = int(mfu.get("step", 3))
+        if mfu_step < 1:
+            raise ValueError(f"telemetry.mfu.step must be >= 1, got "
+                             f"{mfu_step} (step 1 includes the first "
+                             f"compile; the capture skips compiling steps "
+                             f"anyway)")
         ring = int(d.get("ring_size", 4096))
         if ring <= 0:
             raise ValueError(f"telemetry.ring_size must be > 0, got {ring}")
@@ -425,6 +439,8 @@ class TelemetryConfig:
             trace_start_step=None if start is None else int(start),
             trace_num_steps=int(tr.get("num_steps", 3)),
             trace_dir=tr.get("trace_dir"),
+            mfu_enabled=bool(mfu.get("enabled", False)),
+            mfu_step=mfu_step,
         )
 
 

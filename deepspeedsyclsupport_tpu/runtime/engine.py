@@ -24,6 +24,7 @@ with reference user code, implemented over the same jitted kernels.
 import glob as glob_mod
 import json
 import os
+import sys
 import time
 from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
@@ -501,6 +502,16 @@ class Engine:
         self._trace_cfg = jp if jp.get("enabled") else None
         self._tracing = False
         self._trace_origin = None  # "config" windows auto-stop; manual don't
+        # MFU-ledger window (telemetry.mfu): one-shot capture of a clean
+        # (non-compiling) step into its own profiler trace dir; the join
+        # against the roofline partition happens in mfu_ledger()
+        self._mfu_pending = bool(self.telemetry is not None
+                                 and tcfg.mfu_enabled)
+        self._mfu_window = None
+        self._mfu_attempts = 0
+        self._mfu_compile_base = 0
+        self._mfu_trace_dir = os.path.join(
+            tcfg.output_dir, f"mfu_trace_rank{self._fi_rank}")
         self.losses = None
 
     # ================================================================ offload
@@ -817,7 +828,15 @@ class Engine:
     def _apply_grads(self, params, opt_state, scaler, grads):
         """Unscale, overflow-check, update, conditional-skip (reference:
         ``FP16_Optimizer.step`` unscale/overflow path + ``_take_model_step``
-        ``engine.py:2054``)."""
+        ``engine.py:2054``). Traced under the ``optimizer`` MFU region
+        (``monitor/mfu.py``) so the step-time ledger can price the update
+        phase separately from forward/backward."""
+        from ..monitor.mfu import region_scope
+
+        with region_scope("optimizer"):
+            return self._apply_grads_impl(params, opt_state, scaler, grads)
+
+    def _apply_grads_impl(self, params, opt_state, scaler, grads):
         grads = unscale_grads(grads, scaler)
         finite = grads_finite(grads) if self.fp16_enabled else jnp.asarray(True)
         grad_norm = optax.global_norm(grads)
@@ -974,6 +993,28 @@ class Engine:
             # live, or the watchdog would rc-218 the process ~deadline_s
             # later while the caller handles an ordinary error)
             self._watchdog.arm(stepno)
+        # one-shot MFU trace window (telemetry.mfu): bracket EXACTLY this
+        # step with a jax.profiler trace. Offload splits the step across
+        # two programs and manual trace windows would nest — both skip.
+        mfu_capture = False
+        if self._mfu_pending and not self._tracing and \
+                self.offload_device is None and \
+                stepno >= self.config.telemetry.mfu_step:
+            from ..monitor.telemetry import compile_stats
+
+            self._mfu_compile_base = compile_stats()[0]
+            try:
+                # drain the async backlog FIRST: params are step N-1's
+                # output, so waiting on one leaf retires every prior
+                # step's device work — otherwise the window records their
+                # tail and bills it into this step's regions
+                jax.block_until_ready(  # dslint: allow(host-sync-in-step-path)
+                    jax.tree_util.tree_leaves(self.params)[:1])
+                jax.profiler.start_trace(self._mfu_trace_dir)
+                mfu_capture = True
+            except Exception as e:  # a broken profiler must not kill training
+                logger.warning("mfu trace window failed to start: %s", e)
+                self._mfu_pending = False
         t_step = time.perf_counter()
         try:
             if fi.armed:
@@ -1034,7 +1075,19 @@ class Engine:
                 # post-dispatch: the step span recorded in on_step_end
                 # below is the durable post record the pod report joins
                 self._watchdog.disarm(stepno)
+            if mfu_capture and sys.exc_info()[0] is not None:
+                # exception mid-dispatch: close the profiler session so a
+                # caller that survives the error can still trace later
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:  # pragma: no cover - defensive
+                    pass
+                mfu_capture = False
         step_dur = time.perf_counter() - t_step
+        if mfu_capture:
+            # sync + close the window; the synced wall is the ledger's
+            # clean-step time (one deliberately-blocking step)
+            step_dur = self._finish_mfu_window(stepno, t_step, metrics)
         self.global_steps += 1
         self.micro_steps += gas
         if self.telemetry is not None:
@@ -1219,6 +1272,126 @@ class Engine:
                 jax.make_jaxpr(lambda *a: self._train_batch_fn(*a))(*avals),
                 allowed_shapes=param_shapes)
         return report
+
+    # ================================================================ mfu
+    def _finish_mfu_window(self, stepno: int, t_step: float,
+                           metrics: Dict[str, Any]) -> float:
+        """Close the one-shot MFU trace window: block on the step's result
+        (the window's step wall must be device-accurate — this is the one
+        deliberately-synced step), stop the trace, and keep the window only
+        if the step compiled nothing (a compile inside the window is not a
+        clean step; re-arm for a later one, bounded). Returns the synced
+        step duration so goodput accounts the real wall either way."""
+        try:
+            jax.block_until_ready(metrics["loss"])  # dslint: allow(host-sync-in-step-path)
+        finally:
+            dur = time.perf_counter() - t_step
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:  # pragma: no cover - defensive
+                logger.warning("mfu trace window failed to stop: %s", e)
+                self._mfu_pending = False
+                return dur
+        from ..monitor.telemetry import compile_stats
+
+        self._mfu_attempts += 1
+        if compile_stats()[0] - self._mfu_compile_base > 0:
+            if self._mfu_attempts >= 5:
+                self._mfu_pending = False
+                logger.warning(
+                    "mfu window: no clean (non-compiling) step within 5 "
+                    "attempts — shape thrash? see Compile/* events; giving "
+                    "up on the ledger capture")
+            return dur
+        self._mfu_pending = False
+        self._mfu_window = {"step": stepno, "step_s": dur, "steps": 1,
+                            "trace_dir": self._mfu_trace_dir}
+        if self.telemetry is not None:
+            self.telemetry.recorder.record(
+                "event", "mfu/window", step=stepno,
+                data={"step_s": dur, "steps": 1,
+                      "trace_dir": self._mfu_trace_dir})
+        return dur
+
+    def mfu_ledger(self, spec: Any = None, persist: bool = True
+                   ) -> Dict[str, Any]:
+        """The step-time attribution ledger (docs/observability.md "MFU
+        ledger"): joins (1) the roofline partition of the compiled step's
+        jaxpr into named regions (``analysis/roofline.py`` — analytic
+        FLOPs / HBM bytes / comm bytes per ``mfu.*`` scope, priced against
+        the device peak-spec registry), (2) the measured per-op times of
+        the captured clean-step trace window grouped by region via the
+        named_scope metadata XLA stamped into the compiled HLO
+        (``monitor/mfu.py``), and (3) the HLO collective census
+        (partitioner-inserted traffic the jaxpr can't see). Emits the
+        strict ``MFU/*`` event family, persists the offline artifacts
+        (opmap/roofline/window/ledger JSON next to the trace, the
+        ``tools/mfu_report.py`` contract) and returns the ledger dict.
+
+        Requires a captured window (``telemetry.mfu``) and the fused train
+        path — the ZeRO++ explicit step has no retraceable raw fn, and
+        offload splits the step across two programs."""
+        from ..analysis import collective_census, roofline
+        from ..monitor import mfu as mfu_mod
+
+        if self._mfu_window is None:
+            raise RuntimeError(
+                "no MFU trace window captured — enable telemetry.mfu "
+                '({"telemetry": {"enabled": true, "mfu": {"enabled": '
+                'true}}}) and run past telemetry.mfu.step clean steps')
+        if self._train_batch_fn is None or \
+                getattr(self, "_last_train_avals", None) is None or \
+                getattr(self, "_train_batch_raw", None) is None:
+            raise RuntimeError(
+                "mfu_ledger audits the fused train step — run train_batch"
+                "() first (ZeRO++ explicit-shard_map and offload split "
+                "steps are not supported)")
+        avals = self._last_train_avals
+        compiled = self._train_batch_fn.lower(*avals).compile()
+        opmap = mfu_mod.build_opmap(compiled.as_text())
+        costs = roofline.region_costs(
+            jax.make_jaxpr(self._train_batch_raw)(*avals))
+        census_bytes = sum(e["bytes"] for e in collective_census(compiled))
+        spec = spec or roofline.device_spec()
+        table = roofline.roofline_table(costs, spec,
+                                        census_bytes=census_bytes)
+        w = self._mfu_window
+        trace_path = mfu_mod.find_trace(w["trace_dir"])
+        if trace_path is None:
+            raise RuntimeError(f"no trace file under {w['trace_dir']} — "
+                               f"profiler produced no artifacts")
+        events, meta = mfu_mod.parse_trace(trace_path)
+        measured = mfu_mod.measure_regions(events, opmap,
+                                           steps=w.get("steps", 1))
+        led = mfu_mod.ledger(table, measured, w["step_s"],
+                             truncated_trace=meta["truncated"])
+        led["window"] = {"step": w["step"], "trace_path": trace_path}
+        if persist:
+            # the offline-report artifacts (tools/mfu_report.py reads the
+            # trace dir on a jax-less node)
+            for fname, payload in (("mfu_opmap.json", opmap),
+                                   ("mfu_roofline.json", table),
+                                   ("mfu_window.json", w),
+                                   ("mfu_ledger.json", led)):
+                try:
+                    with open(os.path.join(w["trace_dir"], fname),
+                              "w") as f:
+                        json.dump(payload, f)
+                except (OSError, TypeError, ValueError) as e:
+                    logger.warning("mfu artifact %s not written: %s",
+                                   fname, e)
+        if self.telemetry is not None:
+            self.telemetry.recorder.record(
+                "event", "mfu/ledger", step=w["step"],
+                data={k: led[k] for k in
+                      ("achieved_mfu", "roofline_mfu", "step_s",
+                       "device_busy_s", "top_sinks")})
+        if self.monitor.enabled:
+            from ..monitor.telemetry import check_events
+
+            self.monitor.write_events(
+                check_events(mfu_mod.ledger_events(led, step=w["step"])))
+        return led
 
     # ================================================================ eager path
     def forward(self, batch):

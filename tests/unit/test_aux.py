@@ -448,9 +448,9 @@ class TestBenchLadder:
         # of both plans
         assert rungs == ["probe", "kernels_micro", "kernels", "train",
                          "serve", "serve_fused", "serve_goodput",
-                         "multichip", "offload", "fleet"]
+                         "multichip", "offload", "fleet", "train_ring"]
         # kernels timed out → remaining rungs run pinned to CPU
-        for i in (3, 4, 5, 6, 7, 8, 9):
+        for i in (3, 4, 5, 6, 7, 8, 9, 10):
             assert seen[i][1].get("JAX_PLATFORMS") == "cpu"
         lines = capsys.readouterr().out.strip().splitlines()
         head = _json.loads(lines[-1])
@@ -511,7 +511,7 @@ class TestBenchLadder:
         # multichip, offload and fleet are the CPU sim by construction —
         # they run under CPU_ENV even from the TPU plan
         assert cpu_rungs == ["kernels_aot", "serve", "multichip",
-                             "offload", "fleet"], seen
+                             "offload", "fleet", "train_ring"], seen
         # the full TPU plan ran, INCLUDING serve again on the TPU tier
         assert tpu_rungs == [r for r, _t, env, _c in bench.TPU_PLAN
                              if not env], seen

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Diff two bench rounds (``BENCH_*.json``): headline + per-rung deltas.
+
+The bench trajectory was uninspectable without hand-reading JSON — this
+renders an old→new comparison per metric line, flags moves beyond a noise
+threshold in the metric's OWN good direction (throughput up = better;
+TTFT/ITL/latency down = better), carries each train line's ``detail.mfu``
+achieved-MFU alongside its tokens/s, and exits nonzero on regression so a
+round script can gate on it. Stdlib-only, login-node safe.
+
+Usage::
+
+    python tools/bench_diff.py BENCH_r04.json BENCH_r05.json
+    python tools/bench_diff.py old.json new.json --threshold 0.10
+    python tools/bench_diff.py old.json new.json --json diff.json
+
+Exit codes: 0 = no regression beyond the threshold, 1 = at least one
+regression, 2 = unreadable/empty input.
+"""
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+#: a metric is lower-better when its name carries one of these (latency /
+#: time-shaped); everything else (throughput, counts, MFU) is higher-better
+_LOWER_BETTER = ("ttft", "itl", "latency", "_ms", "time_s", "seconds",
+                 "step_s", "p50", "p95", "p99")
+
+
+def lower_is_better(metric: str, unit: str = "") -> bool:
+    m = metric.lower()
+    if any(t in m for t in _LOWER_BETTER):
+        return True
+    return unit.lower() in ("s", "ms", "seconds")
+
+
+def _ingest(rec: Any, out: Dict[str, Dict[str, Any]]) -> None:
+    if not isinstance(rec, dict):
+        return
+    metric = rec.get("metric")
+    if isinstance(metric, str) and "value" in rec and metric not in out:
+        out[metric] = rec
+    # the final aggregate line carries every rung under detail.rungs —
+    # recovers rungs whose own line fell off a truncated tail
+    for sub in (rec.get("detail") or {}).get("rungs", []) or []:
+        _ingest(sub, out)
+
+
+def load_round(path: str) -> Dict[str, Dict[str, Any]]:
+    """One bench round file → ``{metric: line}``. Accepts both raw
+    ``bench.py`` output (JSON lines; non-JSON log lines skipped) and the
+    driver-wrapper format the checked-in ``BENCH_r*.json`` use
+    (``{"tail": "<captured lines>", "parsed": <last line>}``). The FIRST
+    occurrence of a metric wins (the aggregate re-states the headline;
+    rungs emit each metric once)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        print(f"error: cannot read {path}: {e}", file=sys.stderr)
+        return out
+    try:
+        wrapper = json.loads(text)
+    except ValueError:
+        wrapper = None
+    if isinstance(wrapper, dict) and "metric" not in wrapper:
+        text = wrapper.get("tail", "") or ""
+        parsed = wrapper.get("parsed")
+    else:
+        parsed = wrapper
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # torn head of a captured tail / interleaved log
+        _ingest(rec, out)
+    _ingest(parsed, out)
+    return out
+
+
+def _mfu_of(rec: Dict[str, Any]) -> Optional[float]:
+    detail = rec.get("detail") or {}
+    led = detail.get("mfu")
+    if isinstance(led, dict) and led.get("achieved_mfu") is not None:
+        return float(led["achieved_mfu"])
+    # older rounds carry a scalar detail.mfu (fraction of chip peak)
+    if isinstance(detail.get("mfu"), (int, float)):
+        return float(detail["mfu"])
+    return None
+
+
+def diff_rounds(old: Dict[str, Dict[str, Any]],
+                new: Dict[str, Dict[str, Any]],
+                threshold: float) -> Dict[str, Any]:
+    rows: List[Dict[str, Any]] = []
+    for metric in sorted(set(old) | set(new)):
+        o, n = old.get(metric), new.get(metric)
+        if o is None or n is None:
+            rows.append({"metric": metric,
+                         "status": "added" if o is None else "removed",
+                         "old": (o or {}).get("value"),
+                         "new": (n or {}).get("value")})
+            continue
+        try:
+            ov, nv = float(o["value"]), float(n["value"])
+        except (TypeError, ValueError):
+            continue
+        lower = lower_is_better(metric, str(n.get("unit", "")))
+        ratio = (nv / ov) if ov else None
+        if ratio is None:
+            status = "n/a"
+        else:
+            good = (ratio < 1 - threshold) if lower else \
+                (ratio > 1 + threshold)
+            bad = (ratio > 1 + threshold) if lower else \
+                (ratio < 1 - threshold)
+            status = ("improved" if good else
+                      "REGRESSED" if bad else "~")
+        partial = bool((n.get("detail") or {}).get("partial")
+                       or (o.get("detail") or {}).get("partial"))
+        row = {"metric": metric, "status": status, "old": ov, "new": nv,
+               "ratio": ratio, "unit": n.get("unit", ""),
+               "lower_is_better": lower, "partial": partial}
+        om, nm = _mfu_of(o), _mfu_of(n)
+        if om is not None or nm is not None:
+            row["mfu_old"], row["mfu_new"] = om, nm
+        rows.append(row)
+    regressions = [r for r in rows if r["status"] == "REGRESSED"
+                   and not r.get("partial")]
+    return {"rows": rows, "regressions": [r["metric"] for r in regressions],
+            "threshold": threshold}
+
+
+def render(diff: Dict[str, Any], old_name: str, new_name: str) -> str:
+    lines = [f"bench diff — {old_name} -> {new_name} "
+             f"(noise threshold {diff['threshold']:.0%})",
+             f"{'metric':<52}{'old':>12}{'new':>12}{'ratio':>8}  status"]
+    for r in diff["rows"]:
+        if r["status"] in ("added", "removed"):
+            lines.append(f"{r['metric']:<52}{'-':>12}{'-':>12}{'':>8}  "
+                         f"{r['status']}")
+            continue
+        ratio = f"{r['ratio']:.3f}" if r.get("ratio") else "-"
+        arrow = "v better" if r["lower_is_better"] else "^ better"
+        note = r["status"] + (" (partial)" if r.get("partial") else "")
+        lines.append(f"{r['metric']:<52}{r['old']:>12.4g}{r['new']:>12.4g}"
+                     f"{ratio:>8}  {note} [{arrow}]")
+        if r.get("mfu_old") is not None or r.get("mfu_new") is not None:
+            fmt = lambda v: "-" if v is None else f"{100 * v:.2f}%"  # noqa: E731
+            lines.append(f"  {'detail.mfu achieved':<50}"
+                         f"{fmt(r.get('mfu_old')):>12}"
+                         f"{fmt(r.get('mfu_new')):>12}")
+    if diff["regressions"]:
+        lines.append(f"REGRESSIONS ({len(diff['regressions'])}): "
+                     + ", ".join(diff["regressions"]))
+    else:
+        lines.append("no regressions beyond threshold")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Diff two bench rounds; exit 1 on regression beyond "
+                    "the noise threshold.")
+    ap.add_argument("old", help="baseline round (BENCH_*.json)")
+    ap.add_argument("new", help="candidate round")
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    help="relative move counted as signal (default 0.05; "
+                         "CPU-sim rounds are noisy — 0.10+ recommended)")
+    ap.add_argument("--json", metavar="OUT",
+                    help="also write the structured diff to this file")
+    args = ap.parse_args(argv)
+    old = load_round(os.path.expanduser(args.old))
+    new = load_round(os.path.expanduser(args.new))
+    if not old or not new:
+        print("error: no metric lines in "
+              + (args.old if not old else args.new), file=sys.stderr)
+        return 2
+    diff = diff_rounds(old, new, args.threshold)
+    print(render(diff, os.path.basename(args.old),
+                 os.path.basename(args.new)))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(diff, f, indent=1, sort_keys=True)
+    return 1 if diff["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
