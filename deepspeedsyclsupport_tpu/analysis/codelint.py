@@ -68,7 +68,7 @@ def _suppressed(source_lines: Sequence[str], lineno: int, rule: str) -> bool:
 #: host syncs here execute once per step (or per token)
 STEP_PATH_MODULES = (
     "runtime/engine.py", "runtime/zero.py", "runtime/zeropp.py",
-    "runtime/onebit.py", "runtime/loss_scaler.py",
+    "runtime/onebit.py", "runtime/loss_scaler.py", "runtime/sentinel.py",
     "runtime/multihost_offload.py", "runtime/offload_pipeline.py",
     "comm/comm.py", "comm/comms_logging.py",
     "parallel/", "inference/v2/", "moe/",
@@ -97,6 +97,13 @@ HOST_SYNC_SANCTIONED = {
         "MultiHostCPUAdam.__init__", "MultiHostCPUAdam.load_state.pull",
     },
     "runtime/offload_pipeline.py": {"ShardPull.wait"},
+    # the sentinel's ONE designated pull: lag-deferred device_get of step
+    # scalars whose step already retired (and its rollback/abort paths,
+    # which by definition end the overlapped steady state anyway)
+    "runtime/sentinel.py": {
+        "TrainingSentinel._process", "TrainingSentinel._rollback",
+        "TrainingSentinel._abort",
+    },
     "comm/comm.py": {"barrier"},
     "elasticity/elastic_agent.py": set(),
 }

@@ -36,6 +36,11 @@ INDEX_FILE = "state_index.json"
 DATA_FILE = "state.bin"
 STATE_DIR = "state"  # orbax subdir
 LATEST_FILE = "latest"  # tag-pointer file (kept in sync with runtime/engine.py)
+# Health-gated tag pointer (runtime/sentinel.py): names the newest tag the
+# training sentinel PROMOTED — observed K healthy steps beyond it — so a
+# divergence rollback never resumes from a checkpoint that may already carry
+# the poisoned state `latest` happily points at.
+LAST_GOOD_FILE = "last_good"
 INTEGRITY_KEY = "__integrity__"  # manifest section inside META_FILE
 # Two-phase pod commit (all-ranks checkpoint consistency): phase 1 = every
 # rank durably writes its own rank manifest after its shard payload; phase 2
@@ -686,6 +691,59 @@ def find_latest_valid_tag(load_dir: str, deep: bool = True
     return None, skipped
 
 
+def promote_last_good(save_dir: str, tag: str) -> None:
+    """Durably point ``last_good`` at ``tag``. Called by the training
+    sentinel once K healthy steps have been observed *beyond* the tag's save
+    step — promotion lagging health observation is the whole point: a tag is
+    only "good" once the run proved it trained on past it."""
+    path = os.path.join(save_dir, LAST_GOOD_FILE)
+    _durable_write(path + f".tmp{os.getpid()}", tag,
+                   what=f"last_good pointer -> {tag}", rename_to=path)
+
+
+def read_last_good(load_dir: str) -> Optional[str]:
+    p = os.path.join(load_dir, LAST_GOOD_FILE)
+    try:
+        with open(p) as f:
+            tag = f.read().strip()
+    except OSError:
+        return None
+    return tag or None
+
+
+def find_last_good_tag(load_dir: str, deep: bool = False
+                       ) -> Tuple[Optional[str], List[Tuple[str, str]]]:
+    """Newest *health-promoted* tag that passes :func:`verify_tree` — the
+    rollback analog of :func:`find_latest_valid_tag`, but gated on the
+    sentinel's ``last_good`` pointer instead of ``latest``: candidates are
+    the promoted tag itself, then only tags whose recorded ``global_steps``
+    is older (an un-promoted newer tag may already hold diverged state).
+    Returns ``(tag_or_None, [(skipped_tag, reason), ...])``."""
+    skipped: List[Tuple[str, str]] = []
+    promoted = read_last_good(load_dir)
+    if promoted is None:
+        return None, skipped
+    steps_of = {}
+    for tag in list_tags(load_dir):
+        steps = -1
+        try:
+            with open(os.path.join(load_dir, tag, META_FILE)) as f:
+                steps = int(json.load(f).get("global_steps", -1))
+        except (OSError, ValueError, TypeError):
+            pass
+        steps_of[tag] = steps
+    cap = steps_of.get(promoted, -1)
+    candidates = [promoted] + [
+        t for t in list_tags(load_dir)
+        if t != promoted and 0 <= steps_of.get(t, -1) <= cap]
+    for tag in candidates:
+        ok, reason = verify_tree(os.path.join(load_dir, tag), deep=deep)
+        if ok:
+            return tag, skipped
+        skipped.append((tag, reason))
+    return None, skipped
+
+
 def load_latest_valid(load_dir: str, template: Dict[str, Tuple[Any, Any]]
                       ) -> Tuple[Optional[str], Any, Dict[str, Any]]:
     """Load the newest *verified* checkpoint under ``load_dir``, falling back
@@ -736,11 +794,15 @@ def rotate_checkpoints(save_dir: str, keep_last_n: int) -> List[str]:
     if keep_last_n < 1:
         raise ValueError(f"keep_last_n must be >= 1, got {keep_last_n}")
     pointed = _read_latest(save_dir)
+    # the sentinel's promoted rollback target is pinned like `latest`:
+    # rotation must never free the only tag a divergence can rewind to
+    last_good = read_last_good(save_dir)
     # shallow verify: rotation runs after every save, and a deep (full-CRC)
     # pass would re-stream every retained tag's bytes from storage each time
     verified = [t for t in list_tags(save_dir)
                 if verify_tree(os.path.join(save_dir, t), deep=False)[0]]
-    doomed = [t for t in verified[keep_last_n:] if t != pointed]
+    doomed = [t for t in verified[keep_last_n:]
+              if t != pointed and t != last_good]
     for tag in doomed:
         shutil.rmtree(os.path.join(save_dir, tag), ignore_errors=True)
         logger.info("rotated out checkpoint %s", os.path.join(save_dir, tag))

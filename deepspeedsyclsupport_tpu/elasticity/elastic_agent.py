@@ -26,6 +26,9 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 from .elasticity import ElasticityError, compute_elastic_config
 from ..comm.watchdog import COMM_HANG_EXIT_CODE, SERVE_HANG_EXIT_CODE
 from ..runtime.resilience import PREEMPTION_EXIT_CODE
+# imported from sentinel.py directly (not via runtime.resilience) to keep the
+# supervisor's import graph jax-free: sentinel's top level is stdlib+numpy
+from ..runtime.sentinel import DIVERGENCE_EXIT_CODE
 from ..utils.logging import logger
 
 
@@ -52,6 +55,15 @@ class DSElasticAgent:
       exponential backoff, never billed to ``restart_limit``) — the
       restarted replica replays its request journal
       (``inference/v2/supervisor.py``).
+    * ``DIVERGENCE_EXIT_CODE`` (220) — the training-health sentinel
+      (``runtime/sentinel.py``) exhausted its skip/rollback ladder against
+      a numerical fault (NaN'd state, runaway loss). Own streak counter
+      (``divergence_restarts``, bounded by ``divergence_limit``),
+      exponential backoff, never billed to ``restart_limit``: the restart
+      resumes from the promoted *last-good* checkpoint and replays the
+      health journal's skip decisions — but a model that diverges
+      repeatedly from its best known state needs an operator, so the
+      streak limit matters more here than for the hang classes.
     * any other non-zero rc — a real failure: counted against
       ``restart_limit`` and backed off exponentially
       (``backoff_seconds * 2^failures`` + jitter, capped at
@@ -77,6 +89,7 @@ class DSElasticAgent:
                  preemption_limit: Optional[int] = None,
                  comm_hang_limit: Optional[int] = None,
                  serve_hang_limit: Optional[int] = None,
+                 divergence_limit: Optional[int] = None,
                  storm_limit: Optional[int] = None,
                  nprocs: Optional[int] = None,
                  teardown_grace: float = 5.0,
@@ -105,6 +118,10 @@ class DSElasticAgent:
         # consecutive stuck-decode exits (rc 219, the serving-plane
         # watchdog) before giving up — same reasoning as comm hangs
         self.serve_hang_limit = serve_hang_limit
+        # consecutive divergence exits (rc 220, the training-health
+        # sentinel) before giving up — a run that keeps diverging from its
+        # last-good checkpoint needs a human, not a restart loop
+        self.divergence_limit = divergence_limit
         # restart-storm cap: TOTAL relaunches of ANY cause (failure,
         # preemption, comm hang). The per-class limits each bound their own
         # streak; this bounds their sum, so alternating causes can't dodge
@@ -134,6 +151,7 @@ class DSElasticAgent:
         self.preemption_count = 0
         self.comm_hang_count = 0
         self.serve_hang_count = 0
+        self.divergence_count = 0
         self.teardown_count = 0
         self.launch_history: List[Dict[str, Any]] = []
         # set by serving-mode subclasses (ReplicaSupervisor's drain path):
@@ -396,7 +414,7 @@ class DSElasticAgent:
             non_zero = [rc for rc in rcs.values() if rc != 0]
             return 0 if not non_zero else non_zero[0]
         for cause in (COMM_HANG_EXIT_CODE, SERVE_HANG_EXIT_CODE,
-                      PREEMPTION_EXIT_CODE):
+                      DIVERGENCE_EXIT_CODE, PREEMPTION_EXIT_CODE):
             if cause in fails.values():
                 return cause
         return fails[min(fails)]
@@ -415,6 +433,7 @@ class DSElasticAgent:
         consecutive_preemptions = 0
         consecutive_comm_hangs = 0
         consecutive_serve_hangs = 0
+        consecutive_divergences = 0
         while True:
             world = self.discover_world_size()
             if world < self.min_nodes:
@@ -423,7 +442,8 @@ class DSElasticAgent:
             if 0 < self.max_nodes < world:
                 world = self.max_nodes
             attempt = (self.restart_count + self.preemption_count
-                       + self.comm_hang_count + self.serve_hang_count)
+                       + self.comm_hang_count + self.serve_hang_count
+                       + self.divergence_count)
             env = dict(os.environ)
             env.update(self.extra_env)
             env.update(self._resolve(world))
@@ -431,6 +451,7 @@ class DSElasticAgent:
             env["DSTPU_ELASTIC_PREEMPTION_COUNT"] = str(self.preemption_count)
             env["DSTPU_ELASTIC_COMM_HANG_COUNT"] = str(self.comm_hang_count)
             env["DSTPU_ELASTIC_SERVE_HANG_COUNT"] = str(self.serve_hang_count)
+            env["DSTPU_ELASTIC_DIVERGENCE_COUNT"] = str(self.divergence_count)
             # total prior relaunches of any cause: workers use it to rotate
             # rendezvous ports / name per-incarnation artifacts
             env["DSTPU_ELASTIC_ATTEMPT"] = str(attempt)
@@ -443,7 +464,8 @@ class DSElasticAgent:
                  "restart": self.restart_count,
                  "preempted": rc == PREEMPTION_EXIT_CODE,
                  "comm_hang": rc == COMM_HANG_EXIT_CODE,
-                 "serve_hang": rc == SERVE_HANG_EXIT_CODE})
+                 "serve_hang": rc == SERVE_HANG_EXIT_CODE,
+                 "divergence": rc == DIVERGENCE_EXIT_CODE})
             if rc == 0:
                 return 0
             if self._stop_requested:
@@ -457,7 +479,8 @@ class DSElasticAgent:
             resilience_counters.incr("restarts")
             total_relaunches = (self.restart_count + self.preemption_count
                                 + self.comm_hang_count
-                                + self.serve_hang_count)
+                                + self.serve_hang_count
+                                + self.divergence_count)
             if self.storm_limit is not None \
                     and total_relaunches >= self.storm_limit:
                 logger.error("elastic agent: restart storm — %d total "
@@ -465,49 +488,67 @@ class DSElasticAgent:
                              "rc=%d); giving up",
                              total_relaunches, self.storm_limit, rc)
                 return rc
-            if rc in (COMM_HANG_EXIT_CODE, SERVE_HANG_EXIT_CODE):
-                # a watchdog abort — collective (218) or serving decode
-                # (219): stacks and the flight recorder / request journal
-                # are on disk; the restart recovers from the last
-                # pod-complete checkpoint / replays journaled streams. Not
-                # billed against restart_limit (the code didn't crash),
-                # but backed off exponentially — a severed link or a
-                # persistently wedging dispatch would otherwise hot-loop —
+            if rc in (COMM_HANG_EXIT_CODE, SERVE_HANG_EXIT_CODE,
+                      DIVERGENCE_EXIT_CODE):
+                # a watchdog/sentinel abort — collective hang (218),
+                # serving decode hang (219) or training divergence (220):
+                # stacks, flight recorder, request/health journals are on
+                # disk; the restart recovers from the last pod-complete
+                # (for 220: last *promoted* last-good) checkpoint and
+                # replays journaled streams. Not billed against
+                # restart_limit (the code didn't crash), but backed off
+                # exponentially — a severed link, a wedging dispatch or a
+                # persistently diverging model would otherwise hot-loop —
                 # and bounded by its own per-cause consecutive limit.
                 consecutive_failures = 0
                 consecutive_preemptions = 0
                 if rc == SERVE_HANG_EXIT_CODE:
                     consecutive_comm_hangs = 0
+                    consecutive_divergences = 0
                     consecutive_serve_hangs += 1
                     self.serve_hang_count += 1
                     streak, limit = (consecutive_serve_hangs,
                                      self.serve_hang_limit)
-                    what, counter = "serve", "serve_hang_restarts"
+                    what, counter = "serve hang", "serve_hang_restarts"
                     resume = ("restarting; the replica will replay its "
                               "request journal")
                     msg_what = "stuck-decode hang"
+                    nth = self.serve_hang_count
+                elif rc == DIVERGENCE_EXIT_CODE:
+                    consecutive_comm_hangs = 0
+                    consecutive_serve_hangs = 0
+                    consecutive_divergences += 1
+                    self.divergence_count += 1
+                    streak, limit = (consecutive_divergences,
+                                     self.divergence_limit)
+                    what, counter = "divergence", "divergence_restarts"
+                    resume = ("restarting from the promoted last-good "
+                              "checkpoint; the health journal's skip "
+                              "decisions replay deterministically")
+                    msg_what = "training divergence"
+                    nth = self.divergence_count
                 else:
                     consecutive_serve_hangs = 0
+                    consecutive_divergences = 0
                     consecutive_comm_hangs += 1
                     self.comm_hang_count += 1
                     streak, limit = (consecutive_comm_hangs,
                                      self.comm_hang_limit)
-                    what, counter = "comm", "comm_hang_restarts"
+                    what, counter = "comm hang", "comm_hang_restarts"
                     resume = ("restarting from the newest pod-complete "
                               "checkpoint")
                     msg_what = "pod comm hang"
+                    nth = self.comm_hang_count
                 resilience_counters.incr(counter)
                 if limit is not None and streak > limit:
-                    logger.error("elastic agent: %d consecutive %s hangs "
+                    logger.error("elastic agent: %d consecutive %s exits "
                                  "exceeds limit %d — giving up",
                                  streak, what, limit)
                     return rc
                 delay = self.next_backoff(streak)
-                logger.warning("elastic agent: %s (rc=%d, hang #%d) — "
-                               "%s in %.2fs", msg_what, rc,
-                               self.serve_hang_count
-                               if rc == SERVE_HANG_EXIT_CODE
-                               else self.comm_hang_count, resume, delay)
+                logger.warning("elastic agent: %s (rc=%d, #%d) — "
+                               "%s in %.2fs", msg_what, rc, nth, resume,
+                               delay)
                 if delay > 0:
                     self._sleep(delay)
                 continue
@@ -522,6 +563,7 @@ class DSElasticAgent:
                 consecutive_failures = 0
                 consecutive_comm_hangs = 0
                 consecutive_serve_hangs = 0
+                consecutive_divergences = 0
                 if self.preemption_limit is not None \
                         and consecutive_preemptions > self.preemption_limit:
                     logger.error("elastic agent: %d consecutive preemptions "
@@ -542,6 +584,7 @@ class DSElasticAgent:
             consecutive_preemptions = 0
             consecutive_comm_hangs = 0
             consecutive_serve_hangs = 0
+            consecutive_divergences = 0
             if self.restart_count > self.restart_limit:
                 logger.error("elastic agent: restart limit %d exhausted "
                              "(last rc=%d)", self.restart_limit,
@@ -580,6 +623,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="consecutive stuck-decode-watchdog exits (rc 219, "
                          "the serving plane) before the agent gives up "
                          "(default: unbounded)")
+    ap.add_argument("--divergence-limit", type=int, default=None,
+                    help="consecutive training-divergence exits (rc 220, "
+                         "the health sentinel's abort) before the agent "
+                         "gives up (default: unbounded)")
     ap.add_argument("--storm-limit", type=int, default=None,
                     help="TOTAL relaunches of any cause before the agent "
                          "gives up — the restart-storm cap (default: "
@@ -615,6 +662,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                            preemption_limit=args.preemption_limit,
                            comm_hang_limit=args.comm_hang_limit,
                            serve_hang_limit=args.serve_hang_limit,
+                           divergence_limit=args.divergence_limit,
                            storm_limit=args.storm_limit,
                            nprocs=args.nprocs,
                            teardown_grace=args.teardown_grace,

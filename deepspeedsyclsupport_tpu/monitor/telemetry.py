@@ -84,7 +84,13 @@ class ResilienceCounters:
              # serving-plane fault tolerance (PR 11): the stuck-decode
              # watchdog's rc-219 aborts and the supervisor's per-cause
              # restart class for them (inference/v2/supervisor.py)
-             "serve_hang_aborts", "serve_hang_restarts")
+             "serve_hang_aborts", "serve_hang_restarts",
+             # training-health sentinel (runtime/sentinel.py): batches whose
+             # update the sentinel discarded (spike/NaN gate or fp16
+             # overflow — one unified ledger), rollbacks to the promoted
+             # last-good tag, and the elastic agent's per-cause restart
+             # class for rc-220 divergence aborts
+             "skipped_batches", "rollbacks", "divergence_restarts")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -126,8 +132,8 @@ EVENT_NAMES = frozenset(
     {"Train/Samples/train_loss", "Train/Samples/lr",
      "Train/Samples/loss_scale",
      "Goodput/productive_s", "Goodput/checkpoint_s", "Goodput/compile_s",
-     "Goodput/offload_stall_s", "Goodput/startup_s", "Goodput/other_s",
-     "Goodput/total_s", "Goodput/productive_frac",
+     "Goodput/offload_stall_s", "Goodput/rollback_s", "Goodput/startup_s",
+     "Goodput/other_s", "Goodput/total_s", "Goodput/productive_frac",
      # hierarchical offload pipeline (runtime/multihost_offload.py +
      # offload_pipeline.py; docs/offload.md): per-direction bytes and
      # effective bandwidth, host fp32-Adam seconds, exposed transfer
@@ -175,8 +181,19 @@ EVENT_NAMES = frozenset(
      # so the static event-name lint resolves every literal — a typo'd
      # region name fails dslint, not strict mode at runtime.
      "MFU/achieved", "MFU/roofline_bound", "MFU/step_s",
-     "MFU/device_busy_s", "MFU/model_tflops"}
+     "MFU/device_busy_s", "MFU/model_tflops",
+     # training-health sentinel (runtime/sentinel.py; docs/resilience.md
+     # "numerical faults"): robust z-scores of the loss / global grad-norm
+     # history, the run-cumulative nonfinite-gradient element count, ladder
+     # action counts (warn → skip → rollback → abort) and the current
+     # anomaly streak. The per-region grad-norm breakdown is named to the
+     # SAME region registry the MFU ledger uses, enumerated below so the
+     # static event-name lint resolves every member.
+     "Health/loss_z", "Health/grad_norm_z", "Health/nonfinite_count",
+     "Health/warns", "Health/skips", "Health/rollbacks", "Health/aborts",
+     "Health/anomaly_streak"}
     | {f"MFU/region.{r}" for r in MFU_REGIONS}  # dslint: allow(undeclared-event-name) registry-enumerated member builder
+    | {f"Health/grad_norm.{r}" for r in MFU_REGIONS}  # dslint: allow(undeclared-event-name) registry-enumerated member builder
     | {f"Serve/{h}/{q}" for h in ("ttft_s", "itl_s",
                                   "recovery.time_to_recover_s")
        for q in ("p50", "p95", "p99")}
@@ -588,10 +605,14 @@ class GoodputAccounter:
     report tool asserts ≥99% survives serialization/rounding.
     ``offload_stall`` is the exposed (non-overlapped) transfer wait inside
     offloaded steps — carved OUT of productive, because a step blocked on
-    D2H/NVMe is exactly the time the offload pipeline exists to hide."""
+    D2H/NVMe is exactly the time the offload pipeline exists to hide.
+    ``rollback`` is the sentinel's recovery wall (last-good reload + data
+    fast-forward, ``runtime/sentinel.py``) — carved out for the same
+    reason: it is time training exists to avoid, and burying it in
+    productive would hide exactly the cost a divergence inflicts."""
 
     CATEGORIES = ("productive", "checkpoint", "compile", "offload_stall",
-                  "startup", "other")
+                  "rollback", "startup", "other")
 
     def __init__(self, clock: Callable[[], float] = time.monotonic):
         self._clock = clock
@@ -817,6 +838,9 @@ class Telemetry:
         # run-cumulative offload pipeline ledger (record_offload); the
         # Offload/* periodic events derive effective bandwidths from it
         self._offload_totals: Dict[str, float] = {}
+        # run-cumulative health-sentinel ledger (record_health); the
+        # Health/* periodic events are derived from it
+        self._health_totals: Dict[str, Any] = {}
         # latest anchor epoch THIS telemetry stamped on its step spans; the
         # counter behind it is process-global (_next_anchor_seq) so two
         # anchored engines in one process get distinct epochs
@@ -1059,6 +1083,50 @@ class Telemetry:
                                           t["transfer_s"]), step))
         return ev
 
+    def record_health(self, step: int, data: Dict[str, Any]) -> None:
+        """Persist one sentinel observation/decision (``runtime/sentinel.py``
+        verdict shape: cause, z-scores, nonfinite count, action taken,
+        per-region grad norms) as a ``health/step`` record and fold it into
+        the run-cumulative ledger behind the ``Health/*`` periodic events.
+        ``tools/trace_report.py`` renders the records offline."""
+        self.recorder.record("event", "health/step", step=step,
+                             data=dict(data))
+        t = self._health_totals
+        action = data.get("action")
+        if action in ("warn", "skip", "rollback", "abort"):
+            key = action + "s"
+            t[key] = int(t.get(key, 0)) + 1
+        t["nonfinite_count"] = (int(t.get("nonfinite_count", 0))
+                                + int(data.get("nonfinite", 0) or 0))
+        for key in ("loss_z", "grad_norm_z", "streak"):
+            if data.get(key) is not None:
+                t[f"last_{key}"] = float(data[key])
+        for region, norm in (data.get("region_norms") or {}).items():
+            t.setdefault("region_norms", {})[region] = float(norm)
+
+    def health_events(self, step: int) -> List[Event]:
+        """``Health/*`` scalar events from the cumulative sentinel ledger:
+        ladder action counts, last observed robust z-scores, cumulative
+        nonfinite gradient elements and the per-region grad-norm breakdown
+        (named to the MFU region registry)."""
+        t = self._health_totals
+        if not t:
+            return []
+        ev: List[Event] = []
+        for action in ("warns", "skips", "rollbacks", "aborts"):
+            ev.append((f"Health/{action}", int(t.get(action, 0)), step))
+        ev.append(("Health/nonfinite_count",
+                   int(t.get("nonfinite_count", 0)), step))
+        for key, name in (("last_loss_z", "Health/loss_z"),
+                          ("last_grad_norm_z", "Health/grad_norm_z"),
+                          ("last_streak", "Health/anomaly_streak")):
+            if key in t:
+                ev.append((name, t[key], step))
+        for region, norm in sorted((t.get("region_norms") or {}).items()):
+            ev.append((f"Health/grad_norm.{region}",  # dslint: allow(undeclared-event-name) registry-enumerated member builder
+                       norm, step))
+        return ev
+
     def record_census(self, census: Dict[str, Any]) -> None:
         """Persist a static collective-census class summary
         (``analysis/collectives.py`` ``CollectiveClasses.summary()`` shape,
@@ -1116,6 +1184,7 @@ class Telemetry:
         if commit_hist and commit_hist["count"]:
             ev.append(("Ckpt/pod_commit_s", commit_hist["sum"], step))
         ev.extend(self.offload_events(step))
+        ev.extend(self.health_events(step))
         return ev
 
     def dump(self, reason: str = "manual") -> List[Dict[str, Any]]:

@@ -445,6 +445,81 @@ class TelemetryConfig:
 
 
 @dataclass
+class SentinelConfig:
+    """``sentinel`` section — the training-health sentinel
+    (``runtime/sentinel.py``): in-graph NaN/spike gating piggybacked on the
+    step's output fetch, host-side robust z-score detection over the
+    loss/grad-norm history, and the graduated response ladder
+    ``warn → skip_batch → rollback → abort`` (rc 220)."""
+    enabled: bool = False
+    # spike detection arms only after this many healthy steps of history —
+    # early-training loss moves fast and would trip any static threshold
+    warmup_steps: int = 20
+    # history window for the robust (median/MAD) statistics
+    window: int = 64
+    # EWMA smoothing factor for the drift-following baseline
+    ewma_alpha: float = 0.1
+    # robust z at which an observation is a WARN (journaled, update applied)
+    z_warn: float = 4.0
+    # robust z at which the in-graph gate discards the update (skip_batch)
+    z_skip: float = 8.0
+    # consecutive anomalous steps before the ladder escalates to rollback
+    skip_limit: int = 3
+    # rollbacks without an intervening healthy window before abort (rc 220)
+    rollback_limit: int = 2
+    # healthy steps that must be observed BEYOND a saved tag before the
+    # sentinel promotes it as a last-good rollback target
+    last_good_k: int = 4
+    # transient LR cut after a rollback: gradients are scaled by lr_cut for
+    # lr_cut_steps steps (1.0 / 0 disables)
+    lr_cut: float = 1.0
+    lr_cut_steps: int = 0
+    # decision lag in steps: verdict for step N is issued at the boundary of
+    # step N+lag, when N's scalars have already materialized — the sentinel
+    # never adds a blocking host sync to the step path
+    lag: int = 1
+    # rollback source; defaults to wherever the engine last saved
+    checkpoint_dir: Optional[str] = None
+    # health_journal_rank<N>.jsonl location; defaults to telemetry.output_dir
+    journal_dir: Optional[str] = None
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SentinelConfig":
+        z_warn = float(d.get("z_warn", 4.0))
+        z_skip = float(d.get("z_skip", 8.0))
+        if z_skip < z_warn:
+            raise ValueError(f"sentinel.z_skip ({z_skip}) must be >= z_warn "
+                             f"({z_warn}) — the ladder escalates, it does "
+                             f"not invert")
+        lag = int(d.get("lag", 1))
+        if lag < 1:
+            raise ValueError(f"sentinel.lag must be >= 1, got {lag} — lag 0 "
+                             f"would block the host on the in-flight step")
+        for key, lo in (("warmup_steps", 1), ("window", 4),
+                        ("skip_limit", 1), ("rollback_limit", 0),
+                        ("last_good_k", 1), ("lr_cut_steps", 0)):
+            if int(d.get(key, lo)) < lo:
+                raise ValueError(f"sentinel.{key} must be >= {lo}, got "
+                                 f"{d.get(key)}")
+        return cls(
+            enabled=bool(d.get("enabled", False)),
+            warmup_steps=int(d.get("warmup_steps", 20)),
+            window=int(d.get("window", 64)),
+            ewma_alpha=float(d.get("ewma_alpha", 0.1)),
+            z_warn=z_warn,
+            z_skip=z_skip,
+            skip_limit=int(d.get("skip_limit", 3)),
+            rollback_limit=int(d.get("rollback_limit", 2)),
+            last_good_k=int(d.get("last_good_k", 4)),
+            lr_cut=float(d.get("lr_cut", 1.0)),
+            lr_cut_steps=int(d.get("lr_cut_steps", 0)),
+            lag=lag,
+            checkpoint_dir=d.get("checkpoint_dir"),
+            journal_dir=d.get("journal_dir"),
+        )
+
+
+@dataclass
 class CommsLoggerConfig:
     """``comms_logger`` section (reference: ``comm/config.py``)."""
     enabled: bool = False
@@ -600,6 +675,7 @@ class DSTpuConfig:
     flops_profiler: FlopsProfilerConfig
     checkpoint: CheckpointConfig
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    sentinel: SentinelConfig = field(default_factory=SentinelConfig)
     progressive_layer_drop: ProgressiveLayerDropConfig = field(
         default_factory=ProgressiveLayerDropConfig)
     data_efficiency: DataEfficiencyConfig = field(
@@ -653,6 +729,7 @@ class DSTpuConfig:
             flops_profiler=FlopsProfilerConfig.from_dict(_sub(d, C.FLOPS_PROFILER)),
             checkpoint=CheckpointConfig.from_dict(_sub(d, C.CHECKPOINT)),
             telemetry=TelemetryConfig.from_dict(_sub(d, C.TELEMETRY)),
+            sentinel=SentinelConfig.from_dict(_sub(d, "sentinel")),
             progressive_layer_drop=ProgressiveLayerDropConfig.from_dict(
                 _sub(d, "progressive_layer_drop")),
             data_efficiency=DataEfficiencyConfig.from_config_dict(d),

@@ -5,9 +5,20 @@ curriculum-capable). The TPU version's job: take any host iterable of numpy/arra
 pytrees and hand the engine batches already placed with the input sharding
 (dim 0 split over (data, fsdp)), double-buffered so host→HBM transfer overlaps step
 ``n`` compute (the reference gets this from CUDA streams + pin_memory).
+
+Iterator state is checkpointable: both loaders expose
+``state_dict()/load_state_dict()`` (epoch / within-epoch offset / shuffle
+seed), which the engine rides into checkpoint meta so a resume continues the
+stream where the save left it instead of silently replaying or skipping data
+— and which the training sentinel's rollback path (``runtime/sentinel.py``)
+uses to rewind the stream to the last-good step deterministically.
+:class:`CheckpointableDataLoader` goes further: an iterator-object loader
+over a ``Sequence`` dataset whose ``load_state_dict`` takes effect on the
+*next* ``__next__`` even mid-iteration — exactly what an in-place rollback
+needs (a generator-style loader's live iterator could not be rewound).
 """
 import itertools
-from typing import Any, Callable, Iterable, Iterator, Optional
+from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
 
 import jax
 import numpy as np
@@ -25,6 +36,8 @@ class DSTpuDataLoader:
         self.prefetch = max(0, prefetch)
         self.drop_last = drop_last
         self._len = None
+        self._epoch = 0    # completed passes over the dataset
+        self._offset = 0   # batches yielded within the current epoch
         try:
             self._len = len(dataset)  # type: ignore[arg-type]
         except TypeError:
@@ -35,6 +48,25 @@ class DSTpuDataLoader:
             raise TypeError("underlying dataset has no length")
         return self._len
 
+    # ------------------------------------------------------------ state
+    @property
+    def position(self) -> int:
+        """Total batches yielded across the loader's lifetime (epoch-major)
+        when the dataset is sized; within-epoch offset otherwise."""
+        if self._len is None:
+            return self._offset
+        return self._epoch * self._len + self._offset
+
+    def state_dict(self) -> dict:
+        return {"epoch": self._epoch, "offset": self._offset}
+
+    def load_state_dict(self, sd: dict) -> None:
+        """Restore stream position. Takes effect at the next ``__iter__``:
+        the epoch's first ``offset`` batches are fast-forwarded (consumed
+        from the underlying iterable, not yielded)."""
+        self._epoch = int(sd.get("epoch", 0))
+        self._offset = int(sd.get("offset", 0))
+
     def _place(self, batch):
         def put(x):
             arr = np.asarray(x)
@@ -44,9 +76,28 @@ class DSTpuDataLoader:
 
     def __iter__(self) -> Iterator[Any]:
         it = iter(self.dataset)
+        if self._offset:
+            # resume-from-checkpoint fast-forward: burn the already-consumed
+            # head of the epoch so the first yielded batch is the one the
+            # saved run would have seen next
+            it = itertools.islice(it, self._offset, None)
         if self.batch_fn is not None:
             it = (self.batch_fn(b) for b in it)
-        placed = (self._place(b) for b in it)
+
+        def track(source):
+            # increment BEFORE yield: while the consumer trains on batch k
+            # the recorded offset is already k+1, so a checkpoint taken at
+            # that step resumes on the NEXT batch, not a replay of k. (With
+            # prefetch>0 the ring pulls ahead and the offset counts batches
+            # handed to the ring — exact-position checkpointing wants
+            # prefetch=0 or CheckpointableDataLoader.)
+            for b in source:
+                self._offset += 1
+                yield b
+            self._epoch += 1
+            self._offset = 0
+
+        placed = (self._place(b) for b in track(it))
         if self.prefetch == 0:
             yield from placed
             return
@@ -57,6 +108,70 @@ class DSTpuDataLoader:
             yield buf.pop(0)
             buf.append(nxt)
         yield from buf
+
+
+class CheckpointableDataLoader(DSTpuDataLoader):
+    """Random-access loader over a ``Sequence`` dataset with deterministic
+    per-epoch shuffling and *immediate-effect* rewind.
+
+    Differences from the base generator loader, all in service of the
+    sentinel's rollback contract:
+
+    * iterator-object semantics: ``__iter__`` returns ``self`` and
+      ``__next__`` derives the batch index from ``(epoch, offset)`` state on
+      every call — ``load_state_dict`` mid-iteration rewinds the very next
+      batch (no live generator holding a stale position).
+    * per-epoch shuffle from ``np.random.default_rng((seed, epoch))``: the
+      permutation is a pure function of (seed, epoch), so a rewound or
+      resumed run re-derives the identical order with no RNG state blob.
+    * no prefetch ring: rewind would have to invalidate in-flight batches.
+    """
+
+    def __init__(self, dataset: Sequence, topo: MeshTopology,
+                 batch_fn: Optional[Callable[[Any], Any]] = None,
+                 shuffle: bool = False, seed: int = 0):
+        super().__init__(dataset, topo, batch_fn=batch_fn, prefetch=0)
+        if self._len is None:
+            raise TypeError("CheckpointableDataLoader needs a Sequence "
+                            "dataset (random access + __len__)")
+        self.shuffle = bool(shuffle)
+        self.seed = int(seed)
+        self._perm_epoch = None
+        self._perm = None
+
+    def state_dict(self) -> dict:
+        return {"epoch": self._epoch, "offset": self._offset,
+                "shuffle": self.shuffle, "seed": self.seed}
+
+    def load_state_dict(self, sd: dict) -> None:
+        super().load_state_dict(sd)
+        if "seed" in sd:
+            self.seed = int(sd["seed"])
+
+    def _order(self, epoch: int) -> np.ndarray:
+        if self._perm_epoch != epoch:
+            if self.shuffle:
+                rng = np.random.default_rng((self.seed, epoch))
+                self._perm = rng.permutation(self._len)
+            else:
+                self._perm = np.arange(self._len)
+            self._perm_epoch = epoch
+        return self._perm
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self) -> Any:
+        if self._offset >= self._len:
+            self._epoch += 1
+            self._offset = 0
+            raise StopIteration
+        idx = int(self._order(self._epoch)[self._offset])
+        self._offset += 1
+        b = self.dataset[idx]
+        if self.batch_fn is not None:
+            b = self.batch_fn(b)
+        return self._place(b)
 
 
 class RepeatingLoader:

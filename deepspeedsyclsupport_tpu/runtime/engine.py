@@ -40,6 +40,7 @@ from .loss_scaler import (LossScaleState, grads_finite, init_loss_scale, scale_l
                           unscale_grads, update_loss_scale)
 from .lr_schedules import build_schedule
 from .optimizers import build_optimizer, current_lr
+from .sentinel import SENTINEL_GATE_KEY
 from ..checkpoint.engine import LATEST_FILE
 from ..comm.comms_logging import comms_logger
 from ..comm.topology import MeshTopology, build_topology
@@ -103,8 +104,9 @@ def initialize(model: Any = None,
                     sharding_rules=sharding_rules, module=model)
     dataloader = None
     if training_data is not None:
-        dataloader = DSTpuDataLoader(training_data, engine.topology,
-                                     batch_fn=collate_fn)
+        dataloader = engine.register_dataloader(
+            DSTpuDataLoader(training_data, engine.topology,
+                            batch_fn=collate_fn))
     return _InitTuple(engine, engine.optimizer, dataloader, engine.lr_schedule)
 
 
@@ -512,6 +514,18 @@ class Engine:
         self._mfu_compile_base = 0
         self._mfu_trace_dir = os.path.join(
             tcfg.output_dir, f"mfu_trace_rank{self._fi_rank}")
+        # ------------------------------------------------ training sentinel
+        # numerical-fault watchdog (runtime/sentinel.py): in-graph health
+        # scalars + host-side spike detection + the warn/skip/rollback/abort
+        # ladder. The registered dataloader (register_dataloader) is what
+        # rollback rewinds; None when the section is off.
+        self._dataloader = None
+        self._sentinel = None
+        if self.config.sentinel.enabled:
+            from .sentinel import TrainingSentinel
+
+            self._sentinel = TrainingSentinel(self, self.config.sentinel,
+                                              rank=self._fi_rank)
         self.losses = None
 
     # ================================================================ offload
@@ -718,7 +732,7 @@ class Engine:
         backend, returns the new master tree + scalar step metrics."""
 
         def apply_fn(master, opt_state, scaler, grads):
-            new_master, new_opt, new_scaler, finite, grad_norm = \
+            new_master, new_opt, new_scaler, finite, grad_norm, _ = \
                 self._apply_grads(master, opt_state, scaler, grads)
             return new_master, new_opt, new_scaler, {
                 "grad_norm": grad_norm, "finite": finite,
@@ -825,20 +839,33 @@ class Engine:
             grads = jax.lax.with_sharding_constraint(grads, self.grad_shardings)
         return loss, metrics, grads
 
-    def _apply_grads(self, params, opt_state, scaler, grads):
+    def _apply_grads(self, params, opt_state, scaler, grads, ok=None,
+                     emit_health=False):
         """Unscale, overflow-check, update, conditional-skip (reference:
         ``FP16_Optimizer.step`` unscale/overflow path + ``_take_model_step``
         ``engine.py:2054``). Traced under the ``optimizer`` MFU region
         (``monitor/mfu.py``) so the step-time ledger can price the update
-        phase separately from forward/backward."""
+        phase separately from forward/backward.
+
+        ``ok`` (optional traced bool) is the sentinel's in-graph health
+        verdict: when given, the update is additionally gated on it — same
+        discard semantics as an fp16 overflow, but WITHOUT touching the
+        loss-scale state machine (a spiked-but-finite step is not an
+        overflow). ``emit_health=True`` adds the sentinel's device-side
+        scalars (``runtime/sentinel.py health_metrics``) to the return."""
         from ..monitor.mfu import region_scope
 
         with region_scope("optimizer"):
-            return self._apply_grads_impl(params, opt_state, scaler, grads)
+            return self._apply_grads_impl(params, opt_state, scaler, grads,
+                                          ok=ok, emit_health=emit_health)
 
-    def _apply_grads_impl(self, params, opt_state, scaler, grads):
+    def _apply_grads_impl(self, params, opt_state, scaler, grads, ok=None,
+                          emit_health=False):
         grads = unscale_grads(grads, scaler)
-        finite = grads_finite(grads) if self.fp16_enabled else jnp.asarray(True)
+        # the sentinel needs the nonfinite check even in pure-fp32 runs
+        # (where fp16's overflow machinery would skip it)
+        finite = grads_finite(grads) \
+            if (self.fp16_enabled or ok is not None) else jnp.asarray(True)
         grad_norm = optax.global_norm(grads)
         clip = self.config.gradient_clipping
         if self._zeropp_enabled and clip and clip > 0:
@@ -848,20 +875,36 @@ class Engine:
             scale_f = jnp.minimum(1.0, clip / jnp.maximum(grad_norm, 1e-6))
             grads = jax.tree_util.tree_map(lambda g: g * scale_f, grads)
 
-        new_params, new_opt, new_scaler = self._finish_update(
-            params, opt_state, scaler, grads, finite)
-        return new_params, new_opt, new_scaler, finite, grad_norm
+        health = {}
+        if emit_health:
+            # post-unscale: region norms must not wander with the dynamic
+            # loss scale or the host z-score history is meaningless
+            from .sentinel import health_metrics
 
-    def _finish_update(self, params, opt_state, scaler, grads, finite):
+            health = health_metrics(grads)
+        gate = finite if ok is None else (finite & ok)
+        new_params, new_opt, new_scaler = self._finish_update(
+            params, opt_state, scaler, grads, finite, gate=gate)
+        return new_params, new_opt, new_scaler, finite, grad_norm, health
+
+    def _finish_update(self, params, opt_state, scaler, grads, finite,
+                       gate=None):
         """Shared post-norm tail: optimizer update, overflow-skip revert,
         loss-scale bookkeeping. Used by the pjit/eager paths and the ZeRO++
-        shard_map body — fp16 skip semantics live in exactly one place."""
+        shard_map body — fp16 skip semantics live in exactly one place.
+
+        ``gate`` (default: ``finite``) decides whether the update is
+        *applied*; ``finite`` alone keeps driving the loss-scale state
+        machine — a sentinel-gated skip must not burn hysteresis or reset
+        the scale-growth window."""
+        if gate is None:
+            gate = finite
         updates, new_opt = self.optimizer.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
 
         def pick(new, old):
             return jax.tree_util.tree_map(
-                lambda n, o: jnp.where(finite, n, o) if hasattr(n, "dtype") else n,
+                lambda n, o: jnp.where(gate, n, o) if hasattr(n, "dtype") else n,
                 new, old)
 
         new_params = pick(new_params, params)
@@ -887,6 +930,14 @@ class Engine:
         gas = self.config.gradient_accumulation_steps
 
         def train_batch_fn(params, opt_state, scaler, batch, rng):
+            # sentinel gate rider (runtime/sentinel.py): popped BEFORE the
+            # accumulation scan (it is per-step, not per-microbatch — same
+            # reason pld_theta is broadcast but this is not sliced)
+            gate = None
+            if isinstance(batch, dict) and SENTINEL_GATE_KEY in batch:
+                batch = dict(batch)
+                gate = batch.pop(SENTINEL_GATE_KEY)
+
             def micro(carry, mb):
                 acc, i = carry
                 loss, metrics, grads = self._micro_grads(
@@ -910,10 +961,21 @@ class Engine:
                     micro, (zero_grads, 0), batch)
                 grads = jax.tree_util.tree_map(lambda g: g / gas, grads)
                 metrics = jax.tree_util.tree_map(lambda m: m.mean(axis=0), metrics)
-            new_params, new_opt, new_scaler, finite, grad_norm = self._apply_grads(
-                params, opt_state, scaler, grads)
+            ok = None
+            if gate is not None:
+                # in-graph health verdict: discard the update when the mean
+                # loss clears the sentinel's cap. NaN compares False, so a
+                # nonfinite loss is gated even before the host has history.
+                ok = losses.mean() <= gate[0]
+                # transient post-rollback LR cut (gate[1] is 1.0 otherwise —
+                # an exact float no-op)
+                grads = jax.tree_util.tree_map(lambda g: g * gate[1], grads)
+            new_params, new_opt, new_scaler, finite, grad_norm, health = \
+                self._apply_grads(params, opt_state, scaler, grads, ok=ok,
+                                  emit_health=gate is not None)
             out_metrics = {
                 **metrics,
+                **health,
                 "loss": losses.mean(),
                 "grad_norm": grad_norm,
                 "finite": finite,
@@ -930,6 +992,15 @@ class Engine:
         ``(gas, step_batch, ...)`` and scans). The analog of the reference loop
         forward→backward→step and of ``PipelineEngine.train_batch``
         (``pipe/engine.py:321``)."""
+        if self._sentinel is not None and self._sentinel.offer_batch():
+            # journaled bad position being replayed (post-rollback or
+            # post-restart): consume-and-discard BEFORE any dispatch. No
+            # global_steps increment — the replayed trajectory keeps the
+            # clean run's step numbering (and with it the per-step
+            # fold_in(rng, global_steps) stream), which is what makes the
+            # resumed losses float-identical to a run that never saw the
+            # bad batch.
+            return None
         if self.curriculum_scheduler is not None:
             # seqlen curriculum: clip the batch before compile — each
             # difficulty level is one compiled program (difficulty_step
@@ -969,6 +1040,14 @@ class Engine:
             batch = {**batch,
                      "pld_theta": jnp.broadcast_to(t, (gas,)) if gas > 1
                      else t}
+        if self._sentinel is not None and self.offload_device is None and \
+                not self._zeropp_enabled and isinstance(batch, dict):
+            # health-gate rider ([loss_cap, grad_scale], popped inside
+            # train_batch_fn before the scan). Injected every armed step:
+            # its PRESENCE changes the treedef (one retrace when arming),
+            # its VALUES are data and retrace nothing.
+            batch = {**batch,
+                     SENTINEL_GATE_KEY: self._sentinel.gate_array()}
         if self._trace_cfg is not None and not self._tracing and \
                 self.global_steps == int(self._trace_cfg.get("start_step", 1)):
             self.start_profile()
@@ -986,6 +1065,12 @@ class Engine:
             # siblings spin inside the all-reduce and only their watchdogs
             # (or the agent's teardown) end the pod
             fi.maybe_hang_step(self._fi_rank, stepno)
+            # numerical fault (nan_step/loss_spike/bad_batch): poison the
+            # data, not the riders — the sentinel must detect through its
+            # own gate, and pld/gate scalars are engine state
+            batch = fi.corrupt_batch(self._fi_rank, stepno, batch,
+                                     skip_keys=("pld_theta",
+                                                SENTINEL_GATE_KEY))
         if self._watchdog is not None:
             # pre-dispatch deadline stamp: the collective phase is armed
             # until the step's results are back (disarm in the finally
@@ -1475,7 +1560,7 @@ class Engine:
         if self._apply_fn is None:
             def apply_fn(params, opt_state, scaler, grads, count):
                 grads = jax.tree_util.tree_map(lambda g: g / count, grads)
-                new_params, new_opt, new_scaler, finite, grad_norm = \
+                new_params, new_opt, new_scaler, finite, grad_norm, _ = \
                     self._apply_grads(params, opt_state, scaler, grads)
                 return new_params, new_opt, new_scaler, {
                     "finite": finite, "grad_norm": grad_norm,
@@ -1547,6 +1632,11 @@ class Engine:
                 self.global_steps % self.config.steps_per_print == 0:
             self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
                              STEP_GLOBAL_TIMER])
+        if self._sentinel is not None:
+            # lag-deferred health verdicts (runtime/sentinel.py): enqueue
+            # this step's device scalars; entries >= cfg.lag steps old have
+            # retired on device, so their pull is not a pipeline stall
+            self._sentinel.at_step_boundary(self.global_steps, metrics)
         if self._resilience is not None:
             # step boundary: the only point where every buffer is quiescent,
             # so a pending SIGTERM (or injected preemption) saves here
@@ -1610,6 +1700,15 @@ class Engine:
 
     def train_batch_size(self) -> int:
         return self.config.train_batch_size
+
+    def register_dataloader(self, loader):
+        """Attach the loader feeding ``train_batch`` so its iterator state
+        (epoch/offset/seed — ``dataloader.state_dict``) rides checkpoint
+        meta: resumes continue the stream instead of silently replaying or
+        skipping data, and the sentinel's rollback can rewind it.
+        ``initialize()`` registers the loader it builds automatically."""
+        self._dataloader = loader
+        return loader
 
     # ================================================================ resilience
     def enable_preemption_handling(self, save_dir: str,
@@ -1676,6 +1775,14 @@ class Engine:
             meta["random_ltd"] = self.random_ltd_scheduler.state_dict()
         if self.qat_scheduler is not None:
             meta["qat"] = self.qat_scheduler.state_dict()
+        if self._dataloader is not None and \
+                hasattr(self._dataloader, "state_dict"):
+            # iterator position rides the meta: a resume continues the data
+            # stream where this save left it (and the sentinel's rollback
+            # rewinds it deterministically)
+            meta["dataloader"] = self._dataloader.state_dict()
+        if self._sentinel is not None:
+            meta["sentinel"] = self._sentinel.state_dict()
         post_commit = None
         keep = self.config.checkpoint.keep_last_n
         if keep and self._fi_rank == 0:
@@ -1691,6 +1798,10 @@ class Engine:
             tag=tag, post_commit=post_commit)
         if self._swapper is not None:
             self._swap_out_opt_state()
+        if self._sentinel is not None:
+            # the tag enters the last-good promotion queue; it is promoted
+            # only once K healthy steps beyond it are observed
+            self._sentinel.note_checkpoint(tag, self.global_steps, save_dir)
         log_dist(f"saved checkpoint {path} "
                  f"({self.checkpoint_engine.name} engine)")
         return path
@@ -1819,6 +1930,11 @@ class Engine:
             self._train_batch_fn = None  # retrace at the restored precision
             self._eval_fn = None
             self._grad_fn = None
+        if self._dataloader is not None and "dataloader" in meta and \
+                hasattr(self._dataloader, "load_state_dict"):
+            self._dataloader.load_state_dict(meta["dataloader"])
+        if self._sentinel is not None and "sentinel" in meta:
+            self._sentinel.load_state_dict(meta["sentinel"])
         # skipped_steps rides in scaler_state.overflows, restored above
         log_dist(f"loaded checkpoint {path}")
         return path, meta.get("client_state", {})

@@ -114,6 +114,19 @@ def host_update_loss_scale(state: LossScaleState, finite: bool, *,
                           hysteresis_left=np.int32(hys), overflows=overflows)
 
 
+def overflow_ledger(state: LossScaleState) -> dict:
+    """Host-side snapshot of the scaler's overflow bookkeeping for the
+    training sentinel's unified health ledger (``runtime/sentinel.py``): the
+    scaler's skip-on-inf events and the sentinel's spike/NaN skips are the
+    same phenomenon at different severities, and the journal reports them
+    side by side. Forces a device read — call from sanctioned sites only
+    (checkpoint meta, divergence abort), never per step (the sentinel's
+    per-step view rides the ``finite`` metric it already fetches)."""
+    return {"overflows": int(np.asarray(state.overflows)),
+            "scale": float(np.asarray(state.scale)),
+            "good_steps": int(np.asarray(state.good_steps))}
+
+
 def scale_loss(loss, state: LossScaleState):
     return loss * state.scale.astype(loss.dtype)
 

@@ -17,6 +17,13 @@ losing every step since the last periodic checkpoint, so:
 Simulated preemptions (``utils/fault_injection.py`` ``preempt_at_step``) enter
 through the same ``at_step_boundary`` path, so tests exercise the identical
 save-and-exit machinery without process-level signals.
+
+Numerical faults are the sibling fault class: ``runtime/sentinel.py`` owns
+NaN/loss-spike detection and the skip → rollback → abort ladder, exiting
+with :data:`DIVERGENCE_EXIT_CODE` (220, re-exported here) when the ladder is
+exhausted. Its injectors (``nan_step``/``loss_spike``/``bad_batch``,
+``utils/fault_injection.py corrupt_batch``) poison batches in the same
+rank/step-targeted style ``preempt_at_step`` uses for this module.
 """
 import signal
 import sys
@@ -25,6 +32,7 @@ from typing import Any, Callable, Iterable, Optional
 
 from ..utils.fault_injection import get_fault_injector
 from ..utils.logging import logger
+from .sentinel import DIVERGENCE_EXIT_CODE  # noqa: F401  (re-export)
 
 # Distinguished "I was preempted and saved cleanly" exit code. Chosen outside
 # the shell's 126/127/128+N signal-death range so it can't be confused with a

@@ -1,0 +1,595 @@
+"""Training-health sentinel: NaN/spike detection and graduated response.
+
+The fault model so far covers process death (resilience.py, rc 217),
+collective hangs (comm/watchdog.py, rc 218) and the serving plane (rc 219).
+This module closes the remaining class — **numerical faults** — where
+nothing crashes: a NaN'd moment tensor or a loss spike silently poisons
+every subsequent step and every subsequent checkpoint.
+
+Three-part contract:
+
+* **detect** — cheap health scalars are computed *in-graph* and ride the
+  step's existing metrics fetch (``health_*`` keys in ``out_metrics``):
+  global nonfinite element count, per-region grad norms named against the
+  ``monitor/mfu.py`` ``SCOPE_REGIONS`` registry (a NaN is attributed to
+  embed/attn/mlp/head, not just "somewhere"). The host side applies robust
+  z-scores (median/MAD over a sliding window, EWMA-smoothed) to loss and
+  grad-norm history. Decisions are **lag-deferred** (``cfg.lag`` steps): by
+  the time a step's scalars are pulled, that step has retired on device, so
+  the ``jax.device_get`` is a read of materialized buffers, not a pipeline
+  stall — dslint's ``host-sync-in-step-path`` rule stays clean with exactly
+  one sanctioned pull site (``TrainingSentinel._process``).
+
+* **respond** — a graduated ladder. The in-graph gate (a tiny f32 array
+  riding the batch under :data:`SENTINEL_GATE_KEY`: ``[loss_cap,
+  grad_scale]``) discards any update whose mean loss exceeds the cap
+  *before* the host verdict lands, so parameters are never poisoned during
+  the lag window (NaN compares false against any cap, so nonfinite losses
+  are gated even during warmup). The host ladder then escalates:
+  ``warn`` → ``skip_batch`` (journal the stream position; the update was
+  already discarded in-graph) → ``rollback`` (reload the newest *last-good*
+  tag — one the sentinel promoted only after K healthy steps beyond it, see
+  ``checkpoint/engine.py find_last_good_tag`` — rewind the registered
+  dataloader, optionally cut LR transiently) → ``abort`` with
+  :data:`DIVERGENCE_EXIT_CODE` (220), which the elastic agent classes
+  separately from crash/preemption/hang (``--divergence-limit``).
+
+* **prove determinism** — every skip is journaled
+  (``health_journal_rank<N>.jsonl``) and the dataloader position rides the
+  checkpoint meta, so a rolled-back (or restarted) run re-offers the same
+  stream positions and replays the identical skip decisions *pre-dispatch*:
+  the replayed trajectory is float-for-float the run that never saw the bad
+  batches (tests/unit/test_sentinel.py proves losses hex-identical).
+
+Import hygiene: top level is stdlib + numpy only — the elastic agent
+imports :data:`DIVERGENCE_EXIT_CODE` from here and must not drag jax into
+the supervisor process. jax is imported lazily inside the in-graph helpers.
+"""
+import collections
+import json
+import math
+import os
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..monitor.mfu import SCOPE_REGIONS
+from ..utils.logging import logger
+
+#: Distinguished "training diverged past the sentinel's ladder" exit code.
+#: Sibling of 217 (clean preemption), 218 (collective hang) and 219 (serve
+#: hang): outside the shell's signal-death range, classed separately by
+#: ``elasticity/elastic_agent.py`` (``divergence_restarts``,
+#: ``--divergence-limit``).
+DIVERGENCE_EXIT_CODE = 220
+
+#: Batch-dict key the in-graph gate rides under (popped inside
+#: ``train_batch_fn`` before the accumulation scan — same rider idiom as
+#: ``pld_theta``). Value: f32 ``[loss_cap, grad_scale]``.
+SENTINEL_GATE_KEY = "_sentinel_gate"
+
+#: param-path keyword → SCOPE_REGIONS label for the per-region grad-norm
+#: breakdown. First match wins; unmatched leaves land in "other" (a DERIVED
+#: region in monitor/mfu.py, so the Health/grad_norm.<r> registry entry
+#: exists for it).
+_REGION_KEYWORDS = (
+    ("embed", ("embed", "wte", "wpe", "tok_", "pos_")),
+    ("attn", ("attn", "attention", "q_proj", "k_proj", "v_proj", "o_proj",
+              "qkv")),
+    ("mlp", ("mlp", "ffn", "fc", "dense", "w_in", "w_out", "gate_proj",
+             "up_proj", "down_proj")),
+    ("head", ("head", "lm_head", "logits", "unembed")),
+)
+
+#: regions the grad-norm breakdown can emit (SCOPE minus loss/optimizer,
+#: which label *phases*, not parameters) + the unmatched bucket
+GRAD_REGIONS = tuple(r for r in SCOPE_REGIONS
+                     if r not in ("loss", "optimizer")) + ("other",)
+
+
+def region_of_param(path: str) -> str:
+    """Map a flattened param path (e.g. ``layers/3/attn/q_proj/kernel``) to
+    its grad-norm region."""
+    low = path.lower()
+    for region, keys in _REGION_KEYWORDS:
+        if any(k in low for k in keys):
+            return region
+    return "other"
+
+
+# ---------------------------------------------------------------- in-graph
+def health_metrics(grads) -> Dict[str, Any]:
+    """The detect half's device-side scalars, computed on the *unscaled*
+    accumulated grads inside the jitted step (``Engine._apply_grads_impl``)
+    and returned through ``out_metrics`` — they ride the fetch the step
+    already pays for, so arming the sentinel adds no host sync.
+
+    Keys: ``health_nonfinite`` (global nonfinite element count, i32) and
+    ``health_rn_<region>`` (per-region grad norm, f32) for every
+    :data:`GRAD_REGIONS` member present in the tree."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_flatten_with_path(grads)[0]
+    nonfinite = jnp.zeros((), jnp.int32)
+    sq: Dict[str, Any] = {}
+    for path, g in leaves:
+        if not hasattr(g, "dtype") or not jnp.issubdtype(g.dtype,
+                                                         jnp.floating):
+            continue
+        nonfinite = nonfinite + jnp.sum(~jnp.isfinite(g)).astype(jnp.int32)
+        region = region_of_param(jax.tree_util.keystr(path))
+        sq[region] = sq.get(region, 0.0) + jnp.sum(
+            jnp.square(g.astype(jnp.float32)))
+    out: Dict[str, Any] = {"health_nonfinite": nonfinite}
+    for region, s in sq.items():
+        out[f"health_rn_{region}"] = jnp.sqrt(s)
+    return out
+
+
+# ------------------------------------------------------------- host stats
+class RobustStat:
+    """Sliding-window robust statistics for one scalar series: z-scores are
+    (x - median) / (1.4826·MAD), with an EWMA kept alongside for the
+    smoothed trend the journal reports. Anomalous samples are *not* fed
+    back (the caller only calls :meth:`update` on healthy verdicts), so a
+    spike can't widen its own acceptance band."""
+
+    def __init__(self, window: int, alpha: float):
+        self.values: collections.deque = collections.deque(maxlen=window)
+        self.alpha = alpha
+        self.ewma: Optional[float] = None
+        # (median, spread) memo — the step path asks for both several times
+        # per verdict (z on two series + the gate refresh) and the window
+        # only changes on update(); recomputing medians each call was the
+        # dominant host-side cost of arming the sentinel
+        self._memo: Optional[Tuple[float, float]] = None
+
+    def update(self, x: float) -> None:
+        if not math.isfinite(x):
+            return
+        self.values.append(float(x))
+        self.ewma = (float(x) if self.ewma is None
+                     else self.alpha * float(x)
+                     + (1.0 - self.alpha) * self.ewma)
+        self._memo = None
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    @staticmethod
+    def _median_sorted(xs: List[float]) -> float:
+        n = len(xs)
+        mid = n // 2
+        return xs[mid] if n % 2 else 0.5 * (xs[mid - 1] + xs[mid])
+
+    def _stats(self) -> Tuple[float, float]:
+        if self._memo is None:
+            xs = sorted(self.values)
+            med = self._median_sorted(xs)
+            mad = self._median_sorted(sorted(abs(v - med) for v in xs))
+            self._memo = (med, max(1.4826 * mad,
+                                   1e-3 * max(1.0, abs(med))))
+        return self._memo
+
+    def spread(self) -> float:
+        """1.4826·MAD with a relative floor — a perfectly flat history must
+        not turn the band into an equality test."""
+        if not self.values:
+            return float("inf")
+        return self._stats()[1]
+
+    def median(self) -> float:
+        return self._stats()[0] if self.values else float("nan")
+
+    def z(self, x: float) -> float:
+        """Robust z of ``x`` against the current window (inf for nonfinite
+        samples; 0 while the window is empty)."""
+        if not math.isfinite(x):
+            return float("inf")
+        if not self.values:
+            return 0.0
+        return (float(x) - self.median()) / self.spread()
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"values": list(self.values), "ewma": self.ewma}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.values.clear()
+        self.values.extend(float(v) for v in sd.get("values", []))
+        self.ewma = sd.get("ewma")
+        self._memo = None
+
+
+# --------------------------------------------------------------- sentinel
+class TrainingSentinel:
+    """One engine's health sentinel. Wiring (``runtime/engine.py``):
+
+    * ``offer_batch()`` — once per ``train_batch`` call, before any work:
+      advances the stream position and answers whether this position is a
+      journaled bad batch that must be skipped pre-dispatch (replay path).
+    * ``gate_array()`` — the ``[loss_cap, grad_scale]`` rider injected into
+      the batch dict under :data:`SENTINEL_GATE_KEY`.
+    * ``at_step_boundary(global_steps, metrics)`` — from ``_post_step``:
+      enqueue this step's device scalars, then drain every entry at least
+      ``cfg.lag`` steps old (those have retired on device — the deferred
+      ``device_get`` is the module's one sanctioned host sync).
+    * ``note_checkpoint(tag, step, save_dir)`` — from the save path: the
+      tag enters the promotion queue and becomes ``last_good`` once a
+      healthy step ≥ ``step + cfg.last_good_k`` is observed.
+    * ``state_dict()/load_state_dict()`` — rides checkpoint meta (position,
+      window history, streaks) so resumes replay identical decisions;
+      journaled bad positions are additionally re-read from the journal at
+      construction, surviving restarts that predate the last save.
+
+    ``exit_fn`` is injectable (default ``sys.exit``) so tests observe the
+    rc-220 abort without dying."""
+
+    def __init__(self, engine: Any, cfg: Any, rank: int = 0,
+                 exit_fn: Optional[Callable[[int], None]] = None):
+        self.engine = engine
+        self.cfg = cfg
+        self.rank = int(rank)
+        self._exit_fn = exit_fn or sys.exit
+        self._loss_stat = RobustStat(cfg.window, cfg.ewma_alpha)
+        self._gn_stat = RobustStat(cfg.window, cfg.ewma_alpha)
+        # (step, stream position, device-scalar refs) awaiting their lag
+        self._pending: collections.deque = collections.deque()
+        self._position = 0          # batches offered to train_batch so far
+        self._bad_positions = set()  # journaled skip decisions, replayed
+        self._healthy_steps = 0
+        self._anomaly_streak = 0
+        self._rollbacks = 0
+        self._lr_cut_left = 0
+        self._save_dir: Optional[str] = cfg.checkpoint_dir
+        # promotion queue: tags waiting for K healthy steps beyond them
+        self._pending_tags: List[Tuple[str, int]] = []
+        self._promoted_step = -1
+        self._journal_fh = None
+        self._journal_path: Optional[str] = None
+        self._resolve_journal()
+        self._replay_journal()
+
+    # ---------------------------------------------------------- journal
+    def _resolve_journal(self) -> None:
+        d = self.cfg.journal_dir
+        if d is None and getattr(self.engine, "telemetry", None) is not None:
+            d = self.engine.telemetry.cfg.output_dir
+        if d is None:
+            d = self._save_dir
+        if d is None:
+            return
+        os.makedirs(d, exist_ok=True)
+        self._journal_path = os.path.join(
+            d, f"health_journal_rank{self.rank}.jsonl")
+
+    def _replay_journal(self) -> None:
+        """Re-read a pre-existing journal: skip decisions taken before a
+        restart must survive it (the checkpoint meta only carries decisions
+        old enough to have been saved)."""
+        if self._journal_path is None or \
+                not os.path.exists(self._journal_path):
+            return
+        n = 0
+        with open(self._journal_path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail from a crash mid-append
+                if rec.get("event") in ("skip", "nonfinite_skip") and \
+                        rec.get("position") is not None:
+                    self._bad_positions.add(int(rec["position"]))
+                    n += 1
+        if n:
+            logger.info("sentinel: replaying %d journaled skip decision(s) "
+                        "from %s", n, self._journal_path)
+
+    def _journal(self, record: Dict[str, Any]) -> None:
+        if self._journal_path is None:
+            self._resolve_journal()
+            if self._journal_path is None:
+                return
+        if self._journal_fh is None:
+            self._journal_fh = open(self._journal_path, "a")
+        self._journal_fh.write(json.dumps(record) + "\n")
+        self._journal_fh.flush()
+
+    def close(self) -> None:
+        if self._journal_fh is not None:
+            self._journal_fh.close()
+            self._journal_fh = None
+
+    # ------------------------------------------------------- step-path API
+    def offer_batch(self) -> bool:
+        """Advance the stream position; True ⇒ the engine must discard this
+        batch *pre-dispatch* (a journaled skip being replayed after a
+        rollback or restart)."""
+        pos = self._position
+        self._position += 1
+        if pos in self._bad_positions:
+            self._journal({"event": "skip_replay", "position": pos,
+                           "step": self.engine.global_steps})
+            return True
+        return False
+
+    def gate_array(self) -> np.ndarray:
+        """Current ``[loss_cap, grad_scale]`` rider. The cap is the robust
+        band's skip edge once warmed up (+inf before — but NaN losses still
+        gate, NaN compares false); grad_scale is the transient post-rollback
+        LR cut (1.0 otherwise)."""
+        if len(self._loss_stat) >= self.cfg.warmup_steps:
+            cap = (self._loss_stat.median()
+                   + self.cfg.z_skip * self._loss_stat.spread())
+        else:
+            cap = float("inf")
+        scale = self.cfg.lr_cut if self._lr_cut_left > 0 else 1.0
+        return np.asarray([cap, scale], np.float32)
+
+    def at_step_boundary(self, global_steps: int,
+                         metrics: Dict[str, Any]) -> None:
+        """Record this step's device scalars; process every pending step at
+        least ``cfg.lag`` steps old (already retired on device)."""
+        keep = {k: v for k, v in metrics.items()
+                if k in ("loss", "grad_norm", "finite")
+                or k.startswith("health_")}
+        self._pending.append((global_steps, self._position - 1, keep))
+        while self._pending and \
+                self._pending[0][0] <= global_steps - self.cfg.lag:
+            step, pos, m = self._pending.popleft()
+            self._process(step, pos, m)
+
+    # --------------------------------------------------------- the verdict
+    def _process(self, step: int, pos: int, m: Dict[str, Any]) -> None:
+        import jax
+
+        vals = jax.device_get(m)
+        loss = float(np.asarray(vals.get("loss", np.nan)))
+        gn = float(np.asarray(vals.get("grad_norm", np.nan)))
+        finite = bool(np.asarray(vals.get("finite", True)))
+        nonfinite = int(np.asarray(vals.get("health_nonfinite", 0)))
+        regions = {k[len("health_rn_"):]: float(np.asarray(v))
+                   for k, v in vals.items() if k.startswith("health_rn_")}
+        loss_z = self._loss_stat.z(loss)
+        gn_z = self._gn_stat.z(gn)
+        warmed = (len(self._loss_stat) >= self.cfg.warmup_steps)
+
+        loss_bad = math.isnan(loss) or math.isinf(loss)
+        if (not finite or nonfinite > 0) and not loss_bad and \
+                getattr(self.engine, "fp16_enabled", False):
+            # fp16 dynamic-loss-scale overflow (nonfinite grads under a
+            # finite loss): the scaler already skipped the update and will
+            # retry training at a lower scale — a *benign* event, but it
+            # belongs in the same ledger ("overflow events unify into the
+            # sentinel's ledger"). NOT a bad position: the scaler's skip is
+            # itself deterministic, and replay-skipping the batch
+            # pre-dispatch would desync the scaler trajectory from the
+            # original run.
+            self._record("overflow", step, pos, loss, loss_z, gn_z,
+                         nonfinite, regions, skipped=False)
+            return
+        if nonfinite > 0 or not finite or loss_bad:
+            worst = max(regions, key=regions.get) if regions else None
+            self._anomaly(step, pos, "nonfinite", loss, loss_z, gn_z,
+                          nonfinite, regions,
+                          detail=f"nonfinite grads in region "
+                                 f"{worst or '?'}" if nonfinite else
+                                 "nonfinite loss")
+            return
+        if warmed and (loss_z > self.cfg.z_skip or gn_z > self.cfg.z_skip):
+            self._anomaly(step, pos, "spike", loss, loss_z, gn_z,
+                          nonfinite, regions,
+                          detail=f"loss_z={loss_z:.1f} gn_z={gn_z:.1f}")
+            return
+        if warmed and (loss_z > self.cfg.z_warn or gn_z > self.cfg.z_warn):
+            # warn rung: elevated but inside the skip band — surface it,
+            # keep the sample (refusing it would freeze the band) and do
+            # NOT advance the escalation streak
+            self._record("warn", step, pos, loss, loss_z, gn_z, nonfinite,
+                         regions, skipped=False)
+        # healthy (or warned): feed history, settle streaks, promotions
+        self._loss_stat.update(loss)
+        self._gn_stat.update(gn)
+        self._healthy_steps += 1
+        self._anomaly_streak = 0
+        if self._lr_cut_left > 0:
+            self._lr_cut_left -= 1
+        self._check_promotions(step)
+
+    def _anomaly(self, step: int, pos: int, cause: str, loss: float,
+                 loss_z: float, gn_z: float, nonfinite: int,
+                 regions: Dict[str, float], detail: str = "") -> None:
+        from ..monitor.monitor import resilience_counters
+
+        self._anomaly_streak += 1
+        self._bad_positions.add(pos)
+        resilience_counters.incr("skipped_batches")
+        logger.warning(
+            "sentinel: step %d (stream position %d) unhealthy (%s%s); "
+            "update was discarded in-graph, position journaled "
+            "(streak %d/%d)", step, pos, cause,
+            f": {detail}" if detail else "", self._anomaly_streak,
+            self.cfg.skip_limit)
+        self._record("skip", step, pos, loss, loss_z, gn_z, nonfinite,
+                     regions, skipped=True, cause=cause)
+        if self._anomaly_streak >= self.cfg.skip_limit:
+            self._escalate(step, cause)
+
+    def _record(self, action: str, step: int, pos: int, loss: float,
+                loss_z: float, gn_z: float, nonfinite: int,
+                regions: Dict[str, float], skipped: bool,
+                cause: Optional[str] = None) -> None:
+        rec = {"event": action, "step": step, "position": pos,
+               "loss": None if math.isnan(loss) else loss,
+               "loss_z": None if not math.isfinite(loss_z) else
+               round(loss_z, 4),
+               "grad_norm_z": None if not math.isfinite(gn_z) else
+               round(gn_z, 4),
+               "nonfinite": nonfinite}
+        if cause:
+            rec["cause"] = cause
+        if skipped:
+            rec["streak"] = self._anomaly_streak
+        self._journal(rec)
+        telemetry = getattr(self.engine, "telemetry", None)
+        if telemetry is not None:
+            telemetry.record_health(step, {
+                "action": {"overflow": "skip"}.get(action, action),
+                "cause": cause or action, "position": pos,
+                "skipped": skipped,
+                "loss_z": None if not math.isfinite(loss_z) else loss_z,
+                "grad_norm_z": None if not math.isfinite(gn_z) else gn_z,
+                "nonfinite": nonfinite, "streak": self._anomaly_streak,
+                "region_norms": regions})
+
+    # --------------------------------------------------------- escalation
+    def _escalate(self, step: int, cause: str) -> None:
+        if self._rollbacks >= self.cfg.rollback_limit or \
+                self._save_dir is None or \
+                getattr(self.engine, "_dataloader", None) is None:
+            self._abort(step, cause)
+            return
+        self._rollback(step, cause)
+
+    def _rollback(self, step: int, cause: str) -> None:
+        from ..checkpoint.engine import find_last_good_tag
+        from ..monitor.monitor import resilience_counters
+
+        tag, skipped = find_last_good_tag(self._save_dir)
+        if tag is None:
+            logger.error("sentinel: no promoted last-good tag under %s "
+                         "(skipped: %s) — cannot roll back", self._save_dir,
+                         skipped)
+            self._abort(step, cause)
+            return
+        t0 = time.perf_counter()
+        logger.warning("sentinel: anomaly streak hit %d at step %d (%s); "
+                       "rolling back to last-good tag %s",
+                       self._anomaly_streak, step, cause, tag)
+        bad = set(self._bad_positions)   # survive the meta restore below
+        self._pending.clear()            # verdicts for a rewound future
+        self._rollbacks += 1
+        # load_checkpoint restores params/opt/scaler, global_steps, the
+        # registered dataloader's position and this sentinel's saved state
+        # (merged with `bad` in load_state_dict)
+        self.engine.load_checkpoint(self._save_dir, tag)
+        self._bad_positions |= bad
+        self._anomaly_streak = 0
+        self._lr_cut_left = self.cfg.lr_cut_steps
+        rolled_to = self.engine.global_steps
+        # drop queued promotions from the discarded future
+        self._pending_tags = [(t, s) for t, s in self._pending_tags
+                              if s <= rolled_to]
+        dur = time.perf_counter() - t0
+        resilience_counters.incr("rollbacks")
+        telemetry = getattr(self.engine, "telemetry", None)
+        if telemetry is not None:
+            telemetry.goodput.account("rollback", dur)
+            telemetry.record_health(rolled_to, {
+                "action": "rollback", "cause": cause, "tag": tag,
+                "rolled_back_to": rolled_to, "duration_s": round(dur, 3),
+                "streak": 0})
+        self._journal({"event": "rollback", "step": step,
+                       "rolled_back_to": rolled_to, "tag": tag,
+                       "cause": cause, "duration_s": round(dur, 3),
+                       "lr_cut_steps": self._lr_cut_left})
+        logger.warning("sentinel: rolled back to step %d (tag %s) in "
+                       "%.2fs; %d journaled bad position(s) will be "
+                       "skipped on replay", rolled_to, tag, dur,
+                       len(self._bad_positions))
+
+    def _abort(self, step: int, cause: str) -> None:
+        from ..monitor.monitor import resilience_counters  # noqa: F401
+
+        logger.error(
+            "sentinel: divergence at step %d (%s) beyond the response "
+            "ladder (rollbacks %d/%d); exiting with divergence code %d",
+            step, cause, self._rollbacks, self.cfg.rollback_limit,
+            DIVERGENCE_EXIT_CODE)
+        try:
+            # the scaler's overflow ledger joins the post-mortem record:
+            # "the scale collapsed before the NaN" vs "healthy scaler, bad
+            # data" is the first question the journal should answer
+            from .loss_scaler import overflow_ledger
+
+            scaler = overflow_ledger(self.engine.scaler_state)
+        except Exception:  # host-offload scaler layouts etc.
+            scaler = {}
+        self._journal({"event": "abort", "step": step, "cause": cause,
+                       "rollbacks": self._rollbacks, "scaler": scaler})
+        telemetry = getattr(self.engine, "telemetry", None)
+        if telemetry is not None:
+            telemetry.record_health(step, {"action": "abort",
+                                           "cause": cause})
+            try:
+                self.engine._flush_monitor()
+                telemetry.dump("divergence")
+            except Exception as e:  # observability never blocks the exit
+                logger.warning("telemetry dump during divergence abort "
+                               "failed: %s", e)
+        self.close()
+        self._exit_fn(DIVERGENCE_EXIT_CODE)
+
+    # --------------------------------------------------------- promotions
+    def note_checkpoint(self, tag: str, step: int, save_dir: str) -> None:
+        """A checkpoint was written at ``step``: queue it for last-good
+        promotion once ``cfg.last_good_k`` healthy steps beyond it are
+        observed."""
+        self._save_dir = save_dir
+        if self.rank == 0:
+            self._pending_tags.append((tag, int(step)))
+
+    def _check_promotions(self, healthy_step: int) -> None:
+        if not self._pending_tags or self._save_dir is None:
+            return
+        ripe = [(t, s) for t, s in self._pending_tags
+                if healthy_step >= s + self.cfg.last_good_k]
+        if not ripe:
+            return
+        self._pending_tags = [(t, s) for t, s in self._pending_tags
+                              if healthy_step < s + self.cfg.last_good_k]
+        tag, s = max(ripe, key=lambda ts: ts[1])
+        if s <= self._promoted_step:
+            return
+        from ..checkpoint.engine import promote_last_good
+
+        promote_last_good(self._save_dir, tag)
+        self._promoted_step = s
+        logger.info("sentinel: promoted %s (step %d) to last-good "
+                    "(%d healthy steps beyond it)", tag, s,
+                    healthy_step - s)
+
+    # -------------------------------------------------------- persistence
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "position": self._position,
+            "bad_positions": sorted(self._bad_positions),
+            "healthy_steps": self._healthy_steps,
+            "anomaly_streak": self._anomaly_streak,
+            "rollbacks": self._rollbacks,
+            "lr_cut_left": self._lr_cut_left,
+            "promoted_step": self._promoted_step,
+            "pending_tags": [list(ts) for ts in self._pending_tags],
+            "loss_stat": self._loss_stat.state_dict(),
+            "gn_stat": self._gn_stat.state_dict(),
+        }
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self._position = int(sd.get("position", 0))
+        # UNION, not replace: skips journaled after the checkpoint was
+        # written must survive the rollback that restores it
+        self._bad_positions |= {int(p) for p in sd.get("bad_positions", [])}
+        self._healthy_steps = int(sd.get("healthy_steps", 0))
+        self._anomaly_streak = int(sd.get("anomaly_streak", 0))
+        # NOT restored: self._rollbacks — the abort ladder counts rollbacks
+        # per process lifetime, and restoring the saved (pre-rollback) count
+        # would reset the budget every time a rollback loads a checkpoint
+        self._lr_cut_left = int(sd.get("lr_cut_left", 0))
+        self._promoted_step = max(self._promoted_step,
+                                  int(sd.get("promoted_step", -1)))
+        self._pending_tags = [(str(t), int(s))
+                              for t, s in sd.get("pending_tags", [])]
+        self._loss_stat.load_state_dict(sd.get("loss_stat", {}))
+        self._gn_stat.load_state_dict(sd.get("gn_stat", {}))

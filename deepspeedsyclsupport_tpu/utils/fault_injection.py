@@ -77,6 +77,23 @@ deterministic levers behind the crash-replay and stuck-decode contracts in
   returns None). Exercises the structured-backpressure contract: an
   allocation failure must queue/shed through the session, never raise out
   of the engine loop.
+
+Numerical faults (consumed by the engine right before step dispatch; the
+deterministic levers behind the training-health sentinel's ladder,
+``runtime/sentinel.py`` / docs/resilience.md "numerical faults"). Each is
+rank-targeted, fires for ``count`` consecutive steps starting at ``step``
+(default 1), decrements as it fires — so a sentinel rollback that replays
+the step window does NOT re-poison it — and honors the ``attempt`` gate:
+
+* ``nan_step`` — ``{"rank": R, "step": N, "count": 1}``: every float leaf
+  of step ``N``'s batch is multiplied by NaN, so the loss and every
+  gradient go nonfinite. The in-graph health gate must discard the update.
+* ``loss_spike`` — ``{"rank": R, "step": N, "factor": 1e3, "count": 1}``:
+  float batch leaves are scaled by ``factor`` — a finite but wildly
+  out-of-distribution loss, the spike the robust z-score detector names.
+* ``bad_batch`` — ``{"rank": R, "step": N, "fill": 1e4, "count": 1}``:
+  float batch leaves are REPLACED with the constant ``fill`` — garbage
+  data (a corrupt shard read), not merely scaled data.
 """
 import errno
 import json
@@ -112,6 +129,17 @@ class FaultInjector:
         self.tear_pod = dict(spec.get("tear_pod") or {})
         self.decode_wedge = dict(spec.get("decode_wedge") or {})
         self.serve_crash = dict(spec.get("serve_crash") or {})
+        # numerical faults (ISSUE 16): remaining-step counters, decremented
+        # as they fire so a rollback replay never re-poisons the window
+        self.nan_step = dict(spec.get("nan_step") or {})
+        self.loss_spike = dict(spec.get("loss_spike") or {})
+        self.bad_batch = dict(spec.get("bad_batch") or {})
+        self._nan_steps_left = int(self.nan_step.get("count", 1)
+                                   if self.nan_step else 0)
+        self._spike_steps_left = int(self.loss_spike.get("count", 1)
+                                     if self.loss_spike else 0)
+        self._bad_batches_left = int(self.bad_batch.get("count", 1)
+                                     if self.bad_batch else 0)
         self._kv_alloc_fails_left = int(
             (spec.get("kv_alloc_fail") or {}).get("count", 0))
         self._write_failures_left = int(self.write_fail.get("count", 0))
@@ -143,6 +171,7 @@ class FaultInjector:
                     or self.preempt_at_step is not None
                     or self.hang_step or self.kill_step or self.tear_pod
                     or self.decode_wedge or self.serve_crash
+                    or self.nan_step or self.loss_spike or self.bad_batch
                     or self._kv_alloc_fails_left)
 
     # ------------------------------------------------------- injection points
@@ -249,6 +278,62 @@ class FaultInjector:
                 return None
             self._killed = True
         return int(self.kill_step.get("rc", 1))
+
+    def corrupt_batch(self, rank: int, global_steps: int, batch: Any,
+                      skip_keys: tuple = ()) -> Any:
+        """Numerical-fault seam (consumed by the engine right before step
+        dispatch): poison the batch a chosen rank is about to train on.
+        Fires for ``count`` consecutive steps starting at ``step`` and
+        decrements as it fires, so a sentinel rollback that replays the
+        window trains on clean data. Only floating-point leaves are
+        touched; top-level dict keys in ``skip_keys`` (the engine's own
+        riders: ``pld_theta``, the sentinel gate) pass through untouched."""
+        mode = spec = None
+        with self._lock:
+            for name, left_attr, s in (
+                    ("nan_step", "_nan_steps_left", self.nan_step),
+                    ("bad_batch", "_bad_batches_left", self.bad_batch),
+                    ("loss_spike", "_spike_steps_left", self.loss_spike)):
+                left = getattr(self, left_attr)
+                if left <= 0 or not s:
+                    continue
+                if int(s.get("rank", 0)) != int(rank):
+                    continue
+                if global_steps < int(s.get("step", 0)):
+                    continue
+                if not self._attempt_matches(s):
+                    continue
+                setattr(self, left_attr, left - 1)
+                mode, spec = name, s
+                break
+        if mode is None:
+            return batch
+        import jax
+        import numpy as np
+
+        if mode == "nan_step":
+            poison_leaf = lambda x: x * float("nan")  # noqa: E731
+        elif mode == "loss_spike":
+            factor = float(spec.get("factor", 1e3))
+            poison_leaf = lambda x: x * factor  # noqa: E731
+        else:  # bad_batch: replace with a constant, keep shape/dtype/placement
+            fill = float(spec.get("fill", 1e4))
+            poison_leaf = lambda x: x * 0 + fill  # noqa: E731
+
+        def poison(x):
+            dt = getattr(x, "dtype", None)
+            if dt is None or not np.issubdtype(np.dtype(dt), np.floating):
+                return x
+            return poison_leaf(x)
+
+        logger.warning("fault injection: rank %d %s poisoning the batch for "
+                       "step %d", rank, mode, global_steps)
+        if isinstance(batch, dict) and skip_keys:
+            kept = {k: v for k, v in batch.items() if k in skip_keys}
+            poisoned = jax.tree_util.tree_map(
+                poison, {k: v for k, v in batch.items() if k not in kept})
+            return {**poisoned, **kept}
+        return jax.tree_util.tree_map(poison, batch)
 
     def maybe_tear_pod(self, path: str, rank: int) -> Optional[str]:
         """Tear a pod checkpoint's two-phase commit after the save claimed

@@ -49,7 +49,9 @@ from deepspeedsyclsupport_tpu.monitor.monitor import resilience_counters
 from deepspeedsyclsupport_tpu.monitor.telemetry import (FlightRecorder,
                                                         check_events,
                                                         is_declared)
-from deepspeedsyclsupport_tpu.runtime.resilience import PREEMPTION_EXIT_CODE
+from deepspeedsyclsupport_tpu.comm.watchdog import SERVE_HANG_EXIT_CODE
+from deepspeedsyclsupport_tpu.runtime.resilience import (DIVERGENCE_EXIT_CODE,
+                                                         PREEMPTION_EXIT_CODE)
 from deepspeedsyclsupport_tpu.utils.compile_cache import (
     enable_safe_persistent_cache, publish_cache_entries, sweep_stale_staging)
 from deepspeedsyclsupport_tpu.utils.fault_injection import (
@@ -490,6 +492,126 @@ sys.exit(0)
         assert rc in (PREEMPTION_EXIT_CODE, COMM_HANG_EXIT_CODE)
         assert len(agent.launch_history) == 4  # storm cap: 1 + 3 relaunches
         assert (agent.preemption_count + agent.comm_hang_count) == 3
+
+
+# ===================================================== divergence restarts
+class TestAgentDivergenceMode:
+    """rc-220 accounting (ISSUE 16 satellite): the sentinel's divergence
+    abort is its own restart class — never billed against ``restart_limit``,
+    bounded by ``--divergence-limit``, streak-reset by other causes, and a
+    teardown trigger like any self-failure (a diverged rank's siblings are
+    about to all-reduce with poisoned state)."""
+
+    def _pod_agent(self, tmp_path, body, nprocs=2, **kw):
+        """Worker whose behavior is a python expression over (rank,
+        attempt); attempt counts per-rank launches via a marker file."""
+        from deepspeedsyclsupport_tpu.elasticity import DSElasticAgent
+
+        script = tmp_path / "worker.py"
+        script.write_text(f"""
+import os, sys, time
+rank = int(os.environ["RANK"])
+marker = os.path.join({str(tmp_path)!r}, f"attempts_{{rank}}")
+n = int(open(marker).read()) if os.path.exists(marker) else 0
+open(marker, "w").write(str(n + 1))
+{body}
+""")
+        kw.setdefault("env", {"WORLD_SIZE": "8"})
+        kw.setdefault("heartbeat_poll", 0.05)
+        return DSElasticAgent([sys.executable, str(script)],
+                              {"elasticity": {"enabled": False}},
+                              nprocs=nprocs, **kw)
+
+    def test_divergence_limit_bounds_the_streak(self, tmp_path, monkeypatch):
+        """A run that re-diverges from its last-good checkpoint every time
+        needs a human: the per-cause limit stops the loop and surfaces
+        rc 220, with restart_limit untouched (the code didn't crash)."""
+        monkeypatch.setenv("WORLD_SIZE", "8")
+        agent = self._pod_agent(tmp_path, "sys.exit(220)", nprocs=1,
+                                restart_limit=5, divergence_limit=2)
+        assert agent.run() == DIVERGENCE_EXIT_CODE
+        assert agent.divergence_count == 3  # limit + the exceeding attempt
+        assert agent.restart_count == 0     # rc 220 never bills restart_limit
+        assert resilience_counters.get("divergence_restarts") == 3
+
+    def test_other_causes_reset_the_divergence_streak(self, tmp_path,
+                                                      monkeypatch):
+        """divergence → preemption → divergence → clean: each 220 is a
+        streak of ONE (the intervening 217 reset it), so divergence_limit=1
+        never trips and the run converges to 0."""
+        monkeypatch.setenv("WORLD_SIZE", "8")
+        body = "sys.exit([220, 217, 220, 0][min(n, 3)])"
+        agent = self._pod_agent(tmp_path, body, nprocs=1, restart_limit=0,
+                                divergence_limit=1, storm_limit=10)
+        assert agent.run() == 0
+        assert agent.divergence_count == 2
+        assert agent.preemption_count == 1
+        assert agent.restart_count == 0
+        assert [h["divergence"] for h in agent.launch_history] == \
+            [True, False, True, False]
+        assert [h["preempted"] for h in agent.launch_history] == \
+            [False, True, False, False]
+        assert resilience_counters.get("divergence_restarts") == 2
+
+    def test_pod_rc_ranks_divergence_between_hangs_and_preemption(
+            self, tmp_path):
+        """Aggregation unit: among self-exited ranks, hang causes (218/219
+        — infrastructure) outrank divergence (220 — the model), which
+        outranks clean preemption (217) and plain crashes."""
+        agent = self._pod_agent(tmp_path, "sys.exit(0)")
+        rc = agent._pod_rc
+        assert rc({0: 217, 1: 220}, {0: 217, 1: 220}) == DIVERGENCE_EXIT_CODE
+        assert rc({0: 220, 1: 218}, {0: 220, 1: 218}) == COMM_HANG_EXIT_CODE
+        assert rc({0: 219, 1: 220}, {0: 219, 1: 220}) == SERVE_HANG_EXIT_CODE
+        assert rc({0: 220, 1: 1}, {0: 220, 1: 1}) == DIVERGENCE_EXIT_CODE
+        # the diverged rank was reaped by our teardown SIGTERM (not a
+        # self-exit): the surviving self-exit cause attributes instead
+        assert rc({0: 220, 1: -15}, {0: 220}) == DIVERGENCE_EXIT_CODE
+
+    def test_divergence_count_exported_to_workers(self, tmp_path,
+                                                  monkeypatch):
+        """Workers see how many divergence restarts preceded them (e.g. to
+        widen logging or cut LR on the second attempt)."""
+        monkeypatch.setenv("WORLD_SIZE", "8")
+        out = tmp_path / "seen_count"
+        body = f"""
+if n == 0:
+    sys.exit(220)
+open({str(out)!r}, "w").write(os.environ["DSTPU_ELASTIC_DIVERGENCE_COUNT"])
+sys.exit(0)
+"""
+        agent = self._pod_agent(tmp_path, body, nprocs=1, restart_limit=0,
+                                divergence_limit=3)
+        assert agent.run() == 0
+        assert out.read_text() == "1"
+
+    def test_divergence_tears_down_siblings_promptly(self, tmp_path,
+                                                     monkeypatch):
+        """One rank's sentinel aborts with 220 ⇒ its siblings' next
+        collective would hang on poisoned state until the watchdog's
+        deadline — teardown now, attribute to divergence, and never
+        misattribute the SIGTERMed siblings as crashes."""
+        monkeypatch.setenv("WORLD_SIZE", "8")
+        body = """
+if n == 0 and rank == 0:
+    sys.exit(220)          # sentinel: ladder exhausted
+if n == 0:
+    time.sleep(30)         # sibling would cascade-wait without teardown
+sys.exit(0)
+"""
+        agent = self._pod_agent(tmp_path, body, restart_limit=0,
+                                divergence_limit=2, teardown_grace=1.0)
+        t0 = time.monotonic()
+        rc = agent.run()
+        elapsed = time.monotonic() - t0
+        assert rc == 0
+        assert elapsed < 20, f"teardown was not prompt ({elapsed:.1f}s)"
+        assert agent.divergence_count == 1
+        assert agent.teardown_count == 1
+        assert agent.restart_count == 0
+        assert agent.launch_history[0]["divergence"]
+        assert resilience_counters.get("divergence_restarts") == 1
+        assert resilience_counters.get("pod_teardowns") == 1
 
 
 # ========================================================== compile cache

@@ -98,7 +98,8 @@ def goodput_summary(records: List[Dict[str, Any]]) -> List[str]:
     s = summaries[-1].get("data") or {}
     total = float(s.get("total", 0.0)) or 1e-9
     cats = [k for k in ("productive", "checkpoint", "compile",
-                        "offload_stall", "startup", "other") if k in s]
+                        "offload_stall", "rollback", "startup", "other")
+            if k in s]
     accounted = sum(float(s[c]) for c in cats)
     for c in cats:
         v = float(s[c])
@@ -265,6 +266,57 @@ def serve_recovery_summary(records: List[Dict[str, Any]]) -> List[str]:
                          for q, v in qs.items() if v is not None)
         lines.append(f"  time_to_recover ({hist['count']} sample(s)): "
                      f"{qtxt}")
+    return lines
+
+
+def health_summary(records: List[Dict[str, Any]]) -> List[str]:
+    """Training-health view from ``health/step`` records
+    (``runtime/sentinel.py`` verdict shape via ``Telemetry.record_health``):
+    ladder action counts by cause, the skipped data-stream positions a
+    resumed run must replay identically, rollback targets, and the last
+    observed robust z-scores. Empty list when the sentinel never spoke."""
+    health = [r for r in records if r.get("kind") == "event"
+              and r.get("name") == "health/step"]
+    if not health:
+        return []
+    lines = ["training health (sentinel ladder)"]
+    actions: Dict[str, int] = {}
+    causes: Dict[str, int] = {}
+    skipped: List[Any] = []
+    last: Dict[str, Any] = {}
+    for r in health:
+        d = r.get("data") or {}
+        a = d.get("action", "?")
+        actions[a] = actions.get(a, 0) + 1
+        if d.get("cause"):
+            causes[d["cause"]] = causes.get(d["cause"], 0) + 1
+        if d.get("skipped") and d.get("position") is not None:
+            skipped.append(d["position"])
+        for k in ("loss_z", "grad_norm_z", "nonfinite", "streak"):
+            if d.get(k) is not None:
+                last[k] = d[k]
+    lines.append("  actions: " + ", ".join(
+        f"{k}={v}" for k, v in sorted(actions.items())))
+    if causes:
+        lines.append("  causes:  " + ", ".join(
+            f"{k}={v}" for k, v in sorted(causes.items())))
+    if skipped:
+        shown = ", ".join(str(p) for p in skipped[:16])
+        more = "" if len(skipped) <= 16 else f" (+{len(skipped) - 16} more)"
+        lines.append(f"  skipped positions: {shown}{more}")
+    for r in health:
+        d = r.get("data") or {}
+        if d.get("action") == "rollback":
+            lines.append(f"  rollback at step {r.get('step', '?')}: "
+                         f"-> step {d.get('rolled_back_to', '?')} "
+                         f"(tag {d.get('tag', '?')}, "
+                         f"{d.get('duration_s', 0.0):.2f}s)")
+        elif d.get("action") == "abort":
+            lines.append(f"  ABORT at step {r.get('step', '?')}: "
+                         f"cause={d.get('cause', '?')} -> rc 220")
+    if last:
+        lines.append("  last observed: " + ", ".join(
+            f"{k}={last[k]}" for k in sorted(last)))
     return lines
 
 
@@ -473,6 +525,10 @@ def render(paths: List[str], last: int = 20) -> Optional[str]:
     if offload:
         out.append("")
         out.extend(offload)
+    health = health_summary(all_records)
+    if health:
+        out.append("")
+        out.extend(health)
     recovery = serve_recovery_summary(all_records)
     if recovery:
         out.append("")
