@@ -1971,10 +1971,19 @@ def run_fleet():
     params = model.init_params(jax.random.PRNGKey(0))
     rng = np.random.RandomState(0)
 
+    # shared per-tenant prompt HEADS (2 full blocks): clients of one
+    # tenant open with the same system text — the workload shape the
+    # tenant-affinity router co-locates and the replicas' prefix caches
+    # convert into skipped prefill (stats()["realized_reuse"] is the join)
+    head_len = 32
+    tenant_heads = {g: [int(t) for t in rng.randint(
+        1, cfg.vocab_size - 1, size=head_len)] for g in range(8)}
+
     def prompts_for(uid_base, n_clients):
         return {uid_base + c * 1000 + r:
-                [int(t) for t in rng.randint(1, cfg.vocab_size - 1,
-                                             size=prompt_len)]
+                tenant_heads[c % 8]
+                + [int(t) for t in rng.randint(1, cfg.vocab_size - 1,
+                                               size=prompt_len - head_len)]
                 for c in range(n_clients) for r in range(reqs_per_client)}
 
     root = tempfile.mkdtemp(prefix="dstpu_bench_fleet_")
@@ -2017,7 +2026,8 @@ def run_fleet():
         # engine limits) — SLA admission lives at the FLEET EDGE, in the
         # router, so hopeless requests shed before any replica queues
         sess = ServingSession(engines[int(rid)], ServingPolicyConfig(
-            admission="none", journal_path=journal_path(jdir)))
+            admission="none", journal_path=journal_path(jdir),
+            prefix_cache={"enabled": True}))
         sessions.append(sess)
         return LocalReplica(str(rid), sess, journal_dir=jdir)
 
@@ -2084,6 +2094,11 @@ def run_fleet():
                 "replicas_ready": fl["replicas_ready"],
                 "replica_kill": fl.get("failover"),
                 "per_replica": fl["point_per_replica"],
+                # placement-side affinity joined with engine-reported
+                # prefix reuse (cumulative across the sweep)
+                "realized_reuse": {
+                    k: v for k, v in (fl.get("realized_reuse") or {}).items()
+                    if k != "per_replica"},
             }
             points.append(point)
             # flush NOW: a later kill cannot take the completed point back
@@ -2125,9 +2140,227 @@ def run_fleet():
                         "collapse)",
             "goodput_through_fault_nonzero": bool(
                 head["goodput_tok_s"] > 0),
+            "realized_reuse": head.get("realized_reuse"),
             "load_sweep": points,
             "load_points_skipped": skipped,
         }})
+
+
+# ==================================================================
+# rung: serve_prefix (cross-request KV prefix cache A/B — shared system
+# prompt served cache-on vs cache-off; inference/v2/prefix_cache.py,
+# docs/serving.md "prefix reuse")
+# ==================================================================
+def _drive_prefix_arm(eng, prefix_cache, prompts, gen_len, deadline=None):
+    """Submit every request up-front (the queue absorbs the overflow —
+    queue wait is part of TTFT, which is exactly what cached prefill
+    shortens), drive the session to idle, return per-uid outputs + TTFT.
+    Greedy sampling: outputs are a pure function of the prompt, the
+    byte-identity oracle between the arms."""
+    from deepspeedsyclsupport_tpu.inference.v2 import (ServingPolicyConfig,
+                                                       ServingSession)
+
+    sess = ServingSession(eng, ServingPolicyConfig(
+        admission="none", shed_policy="queue", preempt_policy="requeue",
+        prefix_cache=prefix_cache))
+    outs, ttft, submitted = {}, {}, {}
+    t0 = time.perf_counter()
+    for uid in sorted(prompts):
+        submitted[uid] = time.perf_counter()
+        sess.submit(uid, prompts[uid], gen_len)
+    steps = 0
+    while not sess.idle:
+        if deadline is not None and time.perf_counter() > deadline:
+            raise _ScenarioTimeout(
+                f"serve_prefix: arm deadline after {len(outs)}/"
+                f"{len(prompts)} streams started")
+        for ev in sess.step():
+            if ev.kind == "token":
+                if ev.uid not in ttft:
+                    ttft[ev.uid] = ev.t - submitted[ev.uid]
+                outs.setdefault(ev.uid, []).extend(ev.tokens)
+        steps += 1
+        if steps > 50_000:
+            raise RuntimeError(f"serve_prefix arm stalled: {sess.stats()}")
+    return {"outs": outs, "ttft": ttft,
+            "wall_s": time.perf_counter() - t0,
+            "serve": sess.stats(), "prefix": sess.prefix_stats()}
+
+
+def _serve_prefix_once(model_name, platform, *, load_sweep, system_len,
+                       tail_len, gen_len, budget, block_size, max_context,
+                       attn=None, sweep_budget_s=None):
+    """Shared-system-prompt workload (every request = system prompt +
+    unique tail, the RAG/agent shape) served twice per load point on ONE
+    warm engine: cache-off then cache-on. The contract: byte-identical
+    outputs, hit ratio > 0.5 once the first wave has committed the system
+    blocks, and lower mean TTFT (the cached arm's prefill is a block-table
+    copy + the novel tail)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeedsyclsupport_tpu.inference.v2 import InferenceEngineV2
+    from deepspeedsyclsupport_tpu.models import build_model, get_config
+
+    assert system_len % block_size == 0, "system prompt must be full blocks"
+    cfg = get_config(model_name, max_seq_len=max_context)
+    model = build_model(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    max_seqs = 4 if platform != "tpu" else 8
+    extra = _attn_overrides(attn)
+    eng = InferenceEngineV2(
+        model, params, dtype=jnp.float32,
+        config={"max_tokens_per_batch": budget, "block_size": block_size,
+                "max_context": max_context, "max_sequences": max_seqs,
+                # fully-committed pool minus nothing: KV pressure on the
+                # cache-on arm is absorbed by index reclaim, not eviction
+                "num_blocks": max_seqs * (max_context // block_size),
+                **extra})
+    eng.warmup()
+    rng = np.random.RandomState(0)
+    system = [int(t) for t in rng.randint(1, cfg.vocab_size - 1,
+                                          size=system_len)]
+    sweep_end = (time.perf_counter() + sweep_budget_s
+                 if sweep_budget_s else None)
+    # untimed warm drive: the first serving rounds compile the sampler +
+    # the chunked-prefill shapes — neither arm may pay that inside a
+    # timed point (the first point's off arm would otherwise read 10x+
+    # slower on pure compile time)
+    _drive_prefix_arm(
+        eng, None,
+        {90_000_000 + i: system
+         + [int(t) for t in rng.randint(1, cfg.vocab_size - 1,
+                                        size=tail_len)] for i in range(2)},
+        gen_len, deadline=sweep_end)
+    points, skipped = [], []
+    for li, n_req in enumerate(load_sweep):
+        if sweep_end is not None and time.perf_counter() > sweep_end:
+            skipped.append({"requests": n_req, "reason": "sweep budget"})
+            continue
+        tails = [[int(t) for t in rng.randint(1, cfg.vocab_size - 1,
+                                              size=tail_len)]
+                 for _ in range(n_req)]
+        arms = {}
+        try:
+            for ai, arm in enumerate(("off", "on")):
+                # cache-off ALWAYS runs with no cache installed (the A/B
+                # must not ride a previous point's warm index)
+                eng.uninstall_prefix_cache()
+                uid_base = (li * 2 + ai + 1) * 1_000_000
+                prompts = {uid_base + i: system + tails[i]
+                           for i in range(n_req)}
+                arms[arm] = _drive_prefix_arm(
+                    eng, {"enabled": True} if arm == "on" else None,
+                    prompts, gen_len, deadline=sweep_end)
+        except _ScenarioTimeout as e:
+            skipped.append({"requests": n_req, "reason": str(e)})
+            skipped.extend({"requests": r, "reason": "after timeout"}
+                           for r in load_sweep[li + 1:])
+            break
+        # byte identity by request INDEX (uids differ across arms)
+        identical = all(
+            arms["off"]["outs"].get((li * 2 + 1) * 1_000_000 + i)
+            == arms["on"]["outs"].get((li * 2 + 2) * 1_000_000 + i)
+            for i in range(n_req))
+        tt_off = sorted(arms["off"]["ttft"].values())
+        tt_on = sorted(arms["on"]["ttft"].values())
+        mean = lambda xs: sum(xs) / max(len(xs), 1)  # noqa: E731
+        ps = arms["on"]["prefix"] or {}
+        point = {
+            "requests": n_req,
+            "byte_identical": identical,
+            "ttft_mean_off_s": round(mean(tt_off), 4),
+            "ttft_mean_on_s": round(mean(tt_on), 4),
+            "ttft_p95_off_s": round(tt_off[int(0.95 * (len(tt_off) - 1))], 4)
+            if tt_off else None,
+            "ttft_p95_on_s": round(tt_on[int(0.95 * (len(tt_on) - 1))], 4)
+            if tt_on else None,
+            "ttft_speedup": round(mean(tt_off) / max(mean(tt_on), 1e-9), 3),
+            "wall_off_s": round(arms["off"]["wall_s"], 3),
+            "wall_on_s": round(arms["on"]["wall_s"], 3),
+            "hit_ratio": ps.get("hit_ratio", 0.0),
+            "tokens_saved": ps.get("tokens_saved", 0),
+            "blocks_shared": ps.get("blocks_shared", 0),
+            "cow_copies": ps.get("cow_copies", 0),
+            "shed_off": arms["off"]["serve"].get("shed", 0),
+            "shed_on": arms["on"]["serve"].get("shed", 0),
+        }
+        points.append(point)
+        _emit({"metric": f"serve_prefix_point_{model_name}",
+               "value": point["ttft_speedup"], "unit": "x",
+               "vs_baseline": 0.0,
+               "detail": {"platform": platform, "partial": True,
+                          "point": point}})
+    eng.uninstall_prefix_cache()
+    if not points:
+        raise RuntimeError(f"serve_prefix: no load point completed; "
+                           f"skipped={skipped}")
+    head = points[-1]  # highest completed load point
+    return {
+        "metric": f"serve_prefix_ttft_speedup_{model_name}",
+        "value": head["ttft_speedup"],
+        "unit": "x",
+        "vs_baseline": head["ttft_speedup"],
+        "detail": {
+            "platform": platform, "model": model_name,
+            "system_len": system_len, "tail_len": tail_len,
+            "gen_len": gen_len, "block_size": block_size,
+            "attn_impl": attn or "auto",
+            "byte_identical": head["byte_identical"],
+            "hit_ratio": head["hit_ratio"],
+            "tokens_saved": head["tokens_saved"],
+            "load_sweep": points,
+            "load_points_skipped": skipped,
+            "baseline": "same engine, same prompts, prefix cache off — "
+                        "mean-TTFT ratio at the highest completed load "
+                        "point (byte-identical outputs required)"},
+    }
+
+
+def run_serve_prefix():
+    jax = _child_jax()
+
+    platform = jax.devices()[0].platform
+    # system prompt is deliberately SEVERAL budget chunks long: the off
+    # arm's prefill takes multiple chunked forwards, the on arm's cached
+    # hit skips straight to the tail — the TTFT gap is the saved chunks
+    if platform == "tpu":
+        ladder = [
+            dict(model_name="llama-650m", load_sweep=[8, 16, 32],
+                 system_len=512, tail_len=64, gen_len=16, budget=256,
+                 block_size=64, max_context=1024),
+            dict(model_name="llama-650m", load_sweep=[8, 16, 32],
+                 system_len=512, tail_len=64, gen_len=16, budget=256,
+                 block_size=64, max_context=1024, attn="xla"),
+            dict(model_name="tiny", load_sweep=[8, 16, 32],
+                 system_len=512, tail_len=64, gen_len=16, budget=256,
+                 block_size=64, max_context=1024),
+        ]
+    else:
+        ladder = [
+            dict(model_name="tiny", load_sweep=[4, 8, 16],
+                 system_len=256, tail_len=32, gen_len=4, budget=96,
+                 block_size=16, max_context=384),
+        ]
+    rung_end = time.monotonic() + float(
+        os.environ.get("DSTPU_PREFIX_SWEEP_BUDGET", 360))
+    last_err = None
+    for cfg in ladder:
+        remaining = rung_end - time.monotonic()
+        if remaining < 30:
+            last_err = f"{cfg['model_name']}: skipped (rung budget)"
+            break
+        try:
+            _emit(_serve_prefix_once(platform=platform,
+                                     sweep_budget_s=remaining, **cfg))
+            return
+        except Exception as e:
+            last_err = (f"{cfg['model_name']}[{cfg.get('attn') or 'auto'}]: "
+                        f"{str(e)[:300]}")
+            print(f"serve_prefix rung failed: {last_err}", file=sys.stderr)
+            jax.clear_caches()
+    raise RuntimeError(f"all serve_prefix rungs failed; last: {last_err}")
 
 
 # ==================================================================
@@ -2527,6 +2760,7 @@ TPU_PLAN = [("kernels_micro", 400, {}, False),
             ("train", 1200, {}, True),
             ("serve", 700, {}, True),
             ("serve_fused", 500, {}, True),
+            ("serve_prefix", 400, {}, True),
             ("serve_goodput", 700, {}, True),
             ("multichip", 400, CPU_ENV, False),
             ("offload", 500, CPU_ENV, False),
@@ -2535,6 +2769,7 @@ TPU_PLAN = [("kernels_micro", 400, {}, False),
 CPU_PLAN = [("kernels_aot", 400, CPU_ENV, False),
             ("serve", 500, CPU_ENV, False),
             ("serve_fused", 400, CPU_ENV, False),
+            ("serve_prefix", 400, CPU_ENV, False),
             ("serve_goodput", 700, CPU_ENV, False),
             ("train", 700, CPU_ENV, False),
             ("multichip", 400, CPU_ENV, False),
@@ -2549,6 +2784,57 @@ class _Killed(Exception):
     blocking subprocess.run wait, and the whole point is to reach the
     aggregate-flush path below with whatever results exist — the r05
     failure was dying with every rung line buffered in children."""
+
+
+def _bench_diff_gate(all_results):
+    """Round-over-round regression gate: diff this round's in-memory
+    metric lines against the newest checked-in ``BENCH_r*.json`` with
+    ``tools/bench_diff.py`` and print ONE ``BENCH_DIFF`` verdict line
+    (partial per-scenario lines are exempt inside diff_rounds). Advisory
+    by contract — the bench always exits 0; the verdict line and the
+    ``bench_diff`` block on the aggregate are what a round script gates
+    on. Returns the summary dict, or None when no baseline exists."""
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        sys.path.insert(0, os.path.join(here, "tools"))
+        import bench_diff as bd
+    except Exception as e:  # the gate must never take the bench down
+        print(f"BENCH_DIFF skipped: tools/bench_diff.py unusable ({e})",
+              file=sys.stderr)
+        return None
+    finally:
+        sys.path.pop(0)
+    rounds = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
+    if not rounds:
+        print("BENCH_DIFF skipped: no prior BENCH_r*.json baseline")
+        return None
+    prev = rounds[-1]
+    old = bd.load_round(prev)
+    new = {}
+    for r in all_results:
+        bd._ingest(r, new)
+    if not old or not new:
+        print(f"BENCH_DIFF skipped: empty "
+              f"{'baseline' if not old else 'round'}")
+        return None
+    threshold = float(os.environ.get("DSTPU_BENCH_DIFF_THRESHOLD", "0.10"))
+    try:
+        diff = bd.diff_rounds(old, new, threshold)
+    except Exception as e:
+        print(f"BENCH_DIFF skipped: diff failed ({e})", file=sys.stderr)
+        return None
+    regs = diff["regressions"]
+    verdict = "REGRESSED" if regs else "OK"
+    print(f"BENCH_DIFF {verdict} vs {os.path.basename(prev)} "
+          f"(threshold {threshold:.0%}): "
+          + (", ".join(regs) if regs else "no regressions beyond threshold"))
+    return {"baseline": os.path.basename(prev), "threshold": threshold,
+            "verdict": verdict, "regressions": regs,
+            "metrics_compared": sum(1 for r in diff["rows"]
+                                    if r.get("status") not in
+                                    ("added", "removed"))}
 
 
 def main():
@@ -2714,6 +3000,15 @@ def main():
     head["detail"]["probe_attempts"] = probe_attempts
     if errors:
         head["detail"]["rung_errors"] = [e[-700:] for e in errors]
+    # round-over-round regression verdict vs the newest BENCH_r*.json
+    # (tools/bench_diff.py; partial lines exempt) — printed AND attached
+    try:
+        bd_summary = _bench_diff_gate(all_results + [head])
+    except Exception as e:
+        bd_summary = None
+        print(f"BENCH_DIFF skipped: {e}", file=sys.stderr)
+    if bd_summary is not None:
+        head["detail"]["bench_diff"] = bd_summary
     _emit(head)
 
 
@@ -2735,6 +3030,8 @@ if __name__ == "__main__":
         run_serve()
     elif rung == "serve_fused":
         run_serve_fused()
+    elif rung == "serve_prefix":
+        run_serve_prefix()
     elif rung == "serve_goodput":
         run_serve_goodput()
     elif rung == "fleet":

@@ -16,6 +16,9 @@ scheduling:
   can_schedule`` contract (``inference/v2/engine_v2.py:107-237``)
 * :mod:`.serving` — SLA-aware serving policy layer (admission control,
   capacity model, overload-graceful eviction; ``docs/serving.md``)
+* :mod:`.prefix_cache` — cross-request KV prefix cache: block-aligned
+  prefix trie over the paged pool, refcount-shared blocks, copy-on-write
+  (``docs/serving.md`` "prefix reuse")
 * :mod:`.supervisor` — serving-plane fault tolerance: request journal,
   crash-replay recovery, replica supervisor, rc-219 stuck-decode contract
   (``docs/serving.md`` "failure contract")
@@ -26,6 +29,7 @@ scheduling:
 """
 from .config import RaggedInferenceConfig, ServingPolicyConfig  # noqa: F401
 from .engine_v2 import InferenceEngineV2  # noqa: F401
+from .prefix_cache import PrefixCache  # noqa: F401
 from .ragged import BlockedAllocator, RaggedBatch, SequenceDescriptor  # noqa: F401
 from .serving import CapacityModel, ServeEvent, ServingSession  # noqa: F401
 from .supervisor import (RequestJournal, ReplayRequest,  # noqa: F401

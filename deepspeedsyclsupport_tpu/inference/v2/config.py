@@ -181,6 +181,16 @@ class ServingPolicyConfig:
     # preempts the lowest-slack stream to un-wedge the batch — the KV
     # exhaustion self-healing valve (never an exception out of step())
     stall_patience_rounds: int = 3
+    # --- cross-request prefix cache (docs/serving.md "prefix reuse") ----
+    # None = off. A dict installs engine.prefix_cache at session build:
+    #   enabled:           bool, default True (False keeps the dict but
+    #                      skips installation — A/B switch)
+    #   scope:             "tenant" (default; probes never cross tenants)
+    #                      | "global"
+    #   min_block_hits:    offers of a block hash before it is pinned
+    #                      (default 1 — pin on first commit)
+    #   max_pinned_blocks: index pin cap (default: half the KV pool)
+    prefix_cache: Optional[Dict[str, Any]] = None
     extra: Dict[str, Any] = field(default_factory=dict)  # forward-compat bag
 
     def __post_init__(self):
@@ -219,6 +229,23 @@ class ServingPolicyConfig:
         if self.stall_patience_rounds < 1:
             raise ValueError(f"stall_patience_rounds must be >= 1, got "
                              f"{self.stall_patience_rounds}")
+        if self.prefix_cache is not None:
+            known = {"enabled", "scope", "min_block_hits",
+                     "max_pinned_blocks"}
+            unknown = set(self.prefix_cache) - known
+            if unknown:
+                raise ValueError(f"unknown prefix_cache keys: "
+                                 f"{sorted(unknown)} (known: {sorted(known)})")
+            scope = self.prefix_cache.get("scope", "tenant")
+            if scope not in ("tenant", "global"):
+                raise ValueError(f"prefix_cache.scope must be tenant|global, "
+                                 f"got {scope!r}")
+            if int(self.prefix_cache.get("min_block_hits", 1)) < 1:
+                raise ValueError("prefix_cache.min_block_hits must be >= 1")
+            mpb = self.prefix_cache.get("max_pinned_blocks")
+            if mpb is not None and int(mpb) < 1:
+                raise ValueError("prefix_cache.max_pinned_blocks must be "
+                                 ">= 1 or None")
 
     @classmethod
     def from_config(cls, config: Optional[Dict] = None, **kw):

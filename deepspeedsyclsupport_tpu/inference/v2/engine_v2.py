@@ -87,6 +87,10 @@ class InferenceEngineV2:
         # here; put() then orders chunks by slack instead of arrival. None =
         # the pre-SLA least-recently-served ordering.
         self.slack_policy = None
+        # cross-request prefix cache (install_prefix_cache). None = every
+        # stream prefills its full prompt (the pre-sharing behavior).
+        self.prefix_cache = None
+        self._copy_block = None  # jitted CoW block copy, built lazily
         self._tick = 0  # forward counter (LRU eviction / prefill fairness)
         self._forward = build_ragged_forward_fn(model, cfg.block_size,
                                                 attn_impl=cfg.prefill_attn)
@@ -267,15 +271,30 @@ class InferenceEngineV2:
         return not self.check_schedule(uids, lengths).rejected
 
     def check_schedule(self, uids: Sequence[int],
-                       lengths: Sequence[int]) -> "AdmissionResult":
+                       lengths: Sequence[int],
+                       cached_prefix: Optional[Dict[int, int]] = None
+                       ) -> "AdmissionResult":
         """Per-uid admission (the structured form of ``can_schedule``):
         greedily admits uids in caller order while sequence slots, per-seq
         context, and worst-case KV block pressure allow, and names the limit
         that rejected each of the rest — so an external scheduler can back
-        off per sequence instead of all-or-nothing."""
+        off per sequence instead of all-or-nothing.
+
+        ``cached_prefix`` maps a NEW uid to the prefix-cache token count
+        (``prefix_cache.peek``) its prompt would adopt at admission: those
+        block-aligned tokens map to SHARED blocks, so the KV-pressure check
+        prices the request at its novel blocks only — a prefix hit admits
+        work the cold check would reject. The context and slot checks are
+        unaffected (shared tokens still occupy context)."""
         cfg = self.config
         slots = len(self.seqs)
         free = self.allocator.free_blocks
+        if self.prefix_cache is not None:
+            # cold unshared index pins surrender to allocation pressure
+            # (allocator.reclaim_cb), so the KV check counts them as free —
+            # otherwise a pool full of stale pins would reject admissions
+            # that would in fact allocate fine
+            free += self.prefix_cache.reclaimable()
         admitted: List[int] = []
         rejected: Dict[int, str] = {}
         seen: set = set()
@@ -300,7 +319,14 @@ class InferenceEngineV2:
             if d is None and slots + 1 > cfg.max_sequences:
                 rejected[u] = f"slots: engine at max_sequences {cfg.max_sequences}"
                 continue
-            want = max(0, -(-(cached + n) // cfg.block_size) - have)
+            shared = 0
+            if d is None and cached_prefix:
+                # block-aligned cached prefix → that many leading blocks
+                # arrive shared instead of allocated (cap mirrors the
+                # probe's ≥1-novel-token rule)
+                shared = min(int(cached_prefix.get(u, 0)),
+                             max(0, n - 1)) // cfg.block_size
+            want = max(0, -(-(cached + n) // cfg.block_size) - have - shared)
             if want > free:
                 rejected[u] = (f"kv: needs {want} blocks, "
                                f"{free} free in the pool")
@@ -325,9 +351,24 @@ class InferenceEngineV2:
         exception — raise only under ``strict=True``. ``drain=False`` runs
         at most ONE scheduler pass + forward (the granularity an external
         serving loop — or a TTFT benchmark — drives the engine at); the
-        default drains every pending token before returning."""
+        default drains every pending token before returning.
+
+        With a prefix cache installed, each FRESH uid's prompt is probed at
+        admission: matched block-aligned prefix blocks are mapped (shared)
+        into its block table, only the novel tail is enqueued, and the
+        KV-pressure check prices the request at its novel blocks — chunked
+        prefill enters at the first uncached token with positions exact
+        (``token_pos`` continues from ``n_cached``)."""
         cfg = self.config
-        admission = self.check_schedule(uids, [len(t) for t in tokens_list])
+        cached_peek: Dict[int, int] = {}
+        if self.prefix_cache is not None:
+            for uid, toks in zip(uids, tokens_list):
+                if toks and self.seqs.get(uid) is None:
+                    pk = self.prefix_cache.peek(toks)
+                    if pk:
+                        cached_peek[uid] = pk
+        admission = self.check_schedule(uids, [len(t) for t in tokens_list],
+                                        cached_prefix=cached_peek or None)
         if strict and admission.rejected:
             raise RuntimeError(
                 f"cannot schedule batch: {dict(admission.reasons)} "
@@ -339,9 +380,12 @@ class InferenceEngineV2:
                 continue  # duplicate occurrences were rejected, not admitted
             enqueued.add(uid)
             d = self.seqs.get(uid)
+            skip = 0
             if d is None:
                 d = self.seqs[uid] = SequenceDescriptor(uid=uid)
-            d.pending.extend(int(t) for t in toks)
+                if self.prefix_cache is not None and toks:
+                    skip = self.map_cached_prefix(uid, toks)
+            d.pending.extend(int(t) for t in toks[skip:])
             d.last_logits = None
 
         out = PutResult()
@@ -356,14 +400,21 @@ class InferenceEngineV2:
                 policy=self.slack_policy)
             if not chunks:
                 break
+            if self.prefix_cache is not None:
+                for d, n in chunks:
+                    self._ensure_writable(d, n)
             logits = self._run(chunks)
             self._tick += 1
             served_s = time.perf_counter()  # aging base for slack ordering
             for slot, (d, n) in enumerate(chunks):
                 d.last_scheduled = self._tick
                 d.last_service_s = served_s
+                if self.prefix_cache is not None:
+                    d.history.extend(int(t) for t in d.pending[:n])
                 del d.pending[:n]
                 d.n_cached += n
+                if self.prefix_cache is not None:
+                    self._commit_prefix(d)
                 if not d.pending:
                     d.last_logits = logits[slot]
                     out[d.uid] = d.last_logits
@@ -413,18 +464,142 @@ class InferenceEngineV2:
             setattr(d, name, value)
         return d
 
+    # ---------------------------------------------------------- prefix cache
+    def install_prefix_cache(self, *, scope: str = "tenant",
+                             min_block_hits: int = 1,
+                             max_pinned_blocks: Optional[int] = None):
+        """Build and wire the cross-request prefix cache
+        (:class:`~.prefix_cache.PrefixCache`): probes at admission map
+        cached block-aligned prompt prefixes into new streams' block
+        tables, committed full blocks are indexed, and the allocator's
+        pressure valve reclaims cold pins. Idempotent — an installed cache
+        is returned as-is (a session re-installing must not drop the
+        index)."""
+        from .prefix_cache import PrefixCache
+
+        if self.prefix_cache is None:
+            self.prefix_cache = PrefixCache(
+                self.allocator, self.config.block_size, scope=scope,
+                min_block_hits=min_block_hits,
+                max_pinned_blocks=max_pinned_blocks)
+            self.allocator.reclaim_cb = self.prefix_cache.reclaim
+        return self.prefix_cache
+
+    def uninstall_prefix_cache(self) -> None:
+        """Tear the prefix cache down: every index pin released back to
+        the pool, pressure valve unwired. The cache-off arm of an A/B on
+        a shared engine (and tests) — live streams keep their mapped
+        blocks (they hold their own references)."""
+        if self.prefix_cache is not None:
+            self.prefix_cache.invalidate()
+            self.allocator.reclaim_cb = None
+            self.prefix_cache = None
+
+    def map_cached_prefix(self, uid: int, tokens: Sequence[int],
+                          tenant: Optional[str] = None) -> int:
+        """Probe the prefix cache for ``tokens``'s block-aligned head and
+        map the matched blocks into ``uid``'s (fresh) block table: the
+        blocks are retained (shared), ``n_cached``/``cached_prefix_len``
+        advance past them, and the caller enqueues only the novel tail —
+        chunked prefill enters at the first uncached token. Returns the
+        cached token count (0 on miss, no cache, or a non-fresh stream).
+
+        Exactness: positions, sampling and the fused-decode pre-fund all
+        derive from ``n_cached``, so a mapped prefix is indistinguishable
+        from a prefilled one; the probe always leaves ≥ 1 token novel so
+        the stream still runs a forward to produce logits."""
+        pc = self.prefix_cache
+        if pc is None or not tokens:
+            return 0
+        d = self.seqs.get(uid)
+        if d is not None and (d.n_cached or d.pending or d.blocks):
+            return 0  # only a fresh stream can adopt a mapped prefix
+        if tenant is None:
+            tenant = d.tenant if d is not None else "default"
+        blocks, hashes, cached = pc.probe(tokens, tenant)
+        if not cached:
+            return 0
+        if d is None:
+            d = self.seqs[uid] = SequenceDescriptor(uid=uid, tenant=tenant)
+        self.allocator.retain(blocks)
+        d.blocks = list(blocks)
+        d.n_cached = cached
+        d.cached_prefix_len = cached
+        d.history = [int(t) for t in tokens[:cached]]
+        d.block_hashes = list(hashes)
+        return cached
+
+    def _commit_prefix(self, d: SequenceDescriptor) -> None:
+        """Index every newly-FULL block of ``d`` (called after a forward
+        advances ``n_cached`` — the block's KV is committed at that
+        point). Chain hashes extend the descriptor's running chain so each
+        block hashes the entire prefix behind it."""
+        from .prefix_cache import chain_hash
+
+        pc = self.prefix_cache
+        bs = self.config.block_size
+        full = min(len(d.history), d.n_cached) // bs
+        while len(d.block_hashes) < full:
+            i = len(d.block_hashes)
+            prev = d.block_hashes[-1] if d.block_hashes else b""
+            h = chain_hash(prev, d.history[i * bs:(i + 1) * bs])
+            d.block_hashes.append(h)
+            if i < len(d.blocks):
+                pc.offer(d.tenant, h, d.blocks[i])
+
+    def _ensure_writable(self, d: SequenceDescriptor, n_new: int) -> None:
+        """Copy-on-write guard before ``n_new`` KV appends at
+        ``d.n_cached``: any block in the write range still shared
+        (refcount > 1) is copied to a fresh block first and the table
+        entry repointed. With block-aligned sharing the write frontier
+        never sits inside a shared block — full indexed blocks receive no
+        writes — so this is defense-in-depth; a triggered copy is counted
+        (``Serve/prefix.cow_copies``) and a copy that CANNOT allocate is
+        an invariant breach worth a loud failure, not silent corruption
+        of another stream's context."""
+        if self.prefix_cache is None or n_new < 1 or not d.blocks:
+            return
+        alloc = self.allocator
+        bs = self.config.block_size
+        first = d.n_cached // bs
+        last = (d.n_cached + n_new - 1) // bs
+        for bi in range(first, min(last + 1, len(d.blocks))):
+            b = d.blocks[bi]
+            if alloc.refcount(b) <= 1:
+                continue
+            got = alloc.try_allocate(1)
+            if got is None:
+                raise RuntimeError(
+                    f"copy-on-write: no free block to unshare block {b} of "
+                    f"uid {d.uid} — block-aligned sharing should never "
+                    f"write a shared block (scheduler/prefix-cache bug)")
+            if self._copy_block is None:
+                from .kv_cache import build_block_copy_fn
+
+                self._copy_block = build_block_copy_fn(bs)
+            self.kv = self._copy_block(self.kv, jnp.int32(b),
+                                       jnp.int32(got[0]))
+            alloc.release([b])
+            d.blocks[bi] = got[0]
+            self.prefix_cache.note_cow()
+
     def preempt(self, uid: int) -> Optional[SequenceDescriptor]:
         """Overload-graceful eviction: release ``uid``'s KV blocks and slot
         but RETURN the descriptor (emitted count and SLA budget intact, KV
         state reset) so the serving layer can requeue it for a fresh prefill
         or reject it with partial output — instead of the whole batch
-        stalling on an exhausted pool."""
+        stalling on an exhausted pool. Shared blocks only lose this
+        stream's reference — the prefix index and other streams keep
+        theirs (the refcounted-release contract)."""
         d = self.seqs.pop(uid, None)
         if d is None:
             return None
         self.allocator.free(d.blocks)
         d.blocks = []
         d.n_cached = 0
+        d.cached_prefix_len = 0
+        d.history = []
+        d.block_hashes = []
         d.pending.clear()
         d.last_logits = None
         d.last_scheduled = -1
@@ -581,6 +756,12 @@ class InferenceEngineV2:
                     # unwinding is needed and nothing raises mid-serve
                     return None
                 self.seqs[u].blocks.extend(got)
+        if self.prefix_cache is not None:
+            for u in uids:
+                d = self.seqs[u]
+                self._ensure_writable(
+                    d, min(k, running[u],
+                           max(0, cfg.max_context - d.n_cached)))
 
         key = (k, sp.structure)
         fn = self._decode_multi.get(key)
@@ -624,6 +805,13 @@ class InferenceEngineV2:
             d.last_scheduled = self._tick
             d.last_service_s = served_s
             d.emitted += len(emitted[u])
+            if self.prefix_cache is not None:
+                # committed tokens this dispatch = sampled tokens appended
+                # to KV; clamp to n_cached (an early-retiring slot appends
+                # nothing past its final position)
+                d.history.extend(emitted[u])
+                del d.history[d.n_cached:]
+                self._commit_prefix(d)
             if act_h[i]:
                 running[u] = int(sl_h[i])
                 d.last_logits = logits_f[i]

@@ -143,6 +143,14 @@ class ReplicaEndpoint:
     def poll_events(self) -> List[FleetEvent]:
         raise NotImplementedError
 
+    def prefix_stats(self) -> Optional[Dict[str, float]]:
+        """Engine-reported prefix-cache counters (``hits``/``misses``/
+        ``tokens_saved``/``hit_ratio``/...), or None when the replica has
+        no cache (or the transport cannot report) — what the router joins
+        with its placement-side ``Fleet/affinity_hits`` to tell REALIZED
+        reuse from mere co-location."""
+        return None
+
 
 class LocalReplica(ReplicaEndpoint):
     """In-process replica: one :class:`~..serving.ServingSession` behind the
@@ -198,6 +206,9 @@ class LocalReplica(ReplicaEndpoint):
     def poll_events(self) -> List[FleetEvent]:
         out, self._buf = self._buf, []
         return out
+
+    def prefix_stats(self) -> Optional[Dict[str, float]]:
+        return self.session.prefix_stats() if self._alive else None
 
     def kill(self) -> None:
         """Hard death: drop engine KV + session state, keep the journal
@@ -625,21 +636,67 @@ class FleetRouter:
             self._metrics.gauge(f"Fleet/replica.{rid}.live").set(ld["live"])
             self._metrics.gauge(
                 f"Fleet/replica.{rid}.queued").set(ld["queued"])
+            ps = r.prefix_stats()
+            if ps is not None:
+                # engine-reported reuse per replica (the Fleet/replica.
+                # prefix family covers the data-dependent member names) —
+                # the counterpart of the placement-side affinity_hits
+                self._metrics.gauge(
+                    f"Fleet/replica.{rid}.prefix_hits").set(ps["hits"])
+                self._metrics.gauge(
+                    f"Fleet/replica.{rid}.prefix_hit_ratio").set(
+                        ps["hit_ratio"])
+                self._metrics.gauge(
+                    f"Fleet/replica.{rid}.prefix_tokens_saved").set(
+                        ps["tokens_saved"])
 
     @property
     def idle(self) -> bool:
         return not self.flights
 
+    def realized_reuse(self) -> Optional[Dict[str, Any]]:
+        """Join placement-side affinity with engine-reported prefix reuse.
+
+        ``Fleet/affinity_hits`` alone only proves the router SENT
+        same-key requests to the same replica; whether the engine
+        actually reused KV is the replicas' ``Serve/prefix.*`` story.
+        Returns None when no replica reports a prefix cache. The joined
+        view answers the operator question the placement counter cannot:
+        "is sticky placement converting into skipped prefill?"
+        """
+        per: Dict[str, Dict[str, float]] = {}
+        for rid, r in self.replicas.items():
+            ps = r.prefix_stats()
+            if ps is not None:
+                per[rid] = ps
+        if not per:
+            return None
+        hits = sum(int(p["hits"]) for p in per.values())
+        misses = sum(int(p["misses"]) for p in per.values())
+        lookups = hits + misses
+        return {"affinity_hits": self.counters.get("affinity_hits", 0),
+                "prefix_hits": hits,
+                "prefix_lookups": lookups,
+                "prefix_hit_ratio": round(hits / lookups, 4) if lookups
+                else 0.0,
+                "tokens_saved": sum(int(p["tokens_saved"])
+                                    for p in per.values()),
+                "per_replica": per}
+
     def stats(self) -> Dict[str, Any]:
         """Counters + per-replica breakdown for bench lines and operators."""
-        return {**self.counters,
-                **{f"failover_{n}": v
-                   for n, v in self.failover_counters.items()},
-                "inflight": len(self.flights),
-                "replicas_ready": len(self.rotation()),
-                "replicas_dead": sorted(self._dead),
-                "per_replica": {rid: dict(c)
-                                for rid, c in self.per_replica.items()}}
+        out = {**self.counters,
+               **{f"failover_{n}": v
+                  for n, v in self.failover_counters.items()},
+               "inflight": len(self.flights),
+               "replicas_ready": len(self.rotation()),
+               "replicas_dead": sorted(self._dead),
+               "per_replica": {rid: dict(c)
+                               for rid, c in self.per_replica.items()}}
+        reuse = self.realized_reuse()
+        if reuse is not None:
+            out["realized_reuse"] = reuse
+        return out
 
     def summary_events(self, step: Optional[int] = None) -> List[Tuple]:
         """Scalar ``Fleet/*`` events, registry-validated (strict safe)."""

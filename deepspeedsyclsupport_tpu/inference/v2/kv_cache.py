@@ -8,6 +8,7 @@ scatter, which XLA lowers to efficient dynamic-slice traffic on TPU.
 """
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -52,13 +53,44 @@ def init_blocked_kv(model_config, cfg: RaggedInferenceConfig) -> BlockedKV:
 def kv_pool_stats(kv: BlockedKV, allocator) -> dict:
     """Occupancy + footprint of the paged pool, shape-only (no host sync):
     the ``Serve/kv_occupancy`` gauge's source and the operator's answer to
-    "is the pool the bottleneck" — ``occupancy`` is the fraction of blocks
-    sequences currently own; ``pool_bytes`` counts BOTH k and v arrays at
-    the (possibly lane-padded) allocated head dim."""
+    "is the pool the bottleneck" — ``occupancy`` is the PHYSICAL fraction
+    of blocks held by anyone (streams or the prefix index), while
+    ``logical_occupancy`` prices every block-table entry at full cost
+    (sum of refcounts / total): the gap between the two is exactly the HBM
+    the prefix cache's cross-request sharing is saving. ``pool_bytes``
+    counts BOTH k and v arrays at the (possibly lane-padded) allocated
+    head dim."""
     total = allocator.num_blocks
     free = allocator.free_blocks
+    physical = total - free
+    # plain free-list allocators (no refcounts) degenerate to logical ==
+    # physical, shared == 0 — the pre-sharing report
+    logical = int(getattr(allocator, "logical_blocks", physical))
+    shared = int(getattr(allocator, "shared_blocks", 0))
     per_slot = int(np.prod(kv.k.shape[2:])) * kv.k.dtype.itemsize \
         * kv.k.shape[0]
     return {"blocks_total": total, "blocks_free": free,
+            "blocks_physical": physical, "blocks_logical": logical,
+            "blocks_shared": shared,
             "occupancy": 1.0 - free / total,
+            "logical_occupancy": logical / total,
             "pool_bytes": 2 * per_slot * kv.num_slots}
+
+
+def build_block_copy_fn(block_size: int):
+    """Jitted copy of one KV block (both k and v) to a fresh block — the
+    copy-on-write seam for the prefix cache. ``src``/``dst`` are traced
+    int32 operands, so ONE compiled program serves every block pair; the
+    pool is donated (the copy is an in-place update as far as the caller
+    is concerned)."""
+
+    def _copy(kv: BlockedKV, src, dst) -> BlockedKV:
+        L, _, H, D = kv.k.shape
+        sizes = (L, block_size, H, D)
+        ks = jax.lax.dynamic_slice(kv.k, (0, src * block_size, 0, 0), sizes)
+        vs = jax.lax.dynamic_slice(kv.v, (0, src * block_size, 0, 0), sizes)
+        return BlockedKV(
+            jax.lax.dynamic_update_slice(kv.k, ks, (0, dst * block_size, 0, 0)),
+            jax.lax.dynamic_update_slice(kv.v, vs, (0, dst * block_size, 0, 0)))
+
+    return jax.jit(_copy, donate_argnums=0)

@@ -22,7 +22,8 @@ import numpy as np
 
 
 class BlockedAllocator:
-    """KV block free-list (reference ``ragged/blocked_allocator.py``).
+    """Refcounted KV block free-list (reference ``ragged/blocked_allocator.py``
+    plus vLLM-style per-block reference counts for cross-request sharing).
 
     Serving-loop callers (the scheduler's chunk admission, the fused-decode
     pre-fund) go through :meth:`try_allocate`: exhaustion — real or
@@ -31,22 +32,58 @@ class BlockedAllocator:
     stays pending / falls back to the evicting per-token path) instead of
     an exception tearing down the whole serving loop. :meth:`allocate`
     keeps the raising contract for callers that pre-checked.
+
+    Sharing contract (prefix cache, docs/serving.md "prefix reuse"): a
+    freshly allocated block has refcount 1; every additional holder
+    (another stream's block table, the prefix index's pin) must
+    :meth:`retain` it, and every holder releases through
+    :meth:`release`/:meth:`free` — the block returns to the free list only
+    when its LAST holder lets go, so eviction/preempt/failover all route
+    through the same refcounted release and can never tear a shared block
+    out from under a live stream. ``reclaim_cb`` (installed with the
+    prefix cache) is the pressure valve: a shortfall asks the cache to
+    unpin cold unshared blocks before the allocator reports exhaustion.
     """
 
     def __init__(self, num_blocks: int):
         if num_blocks < 1:
             raise ValueError("need at least one block")
         self._free: List[int] = list(range(num_blocks))
+        self._refs: List[int] = [0] * num_blocks
         self.num_blocks = num_blocks
+        # pressure hook: called with the block shortfall before allocation
+        # fails; returns how many blocks it freed (prefix_cache.reclaim)
+        self.reclaim_cb = None
 
     @property
     def free_blocks(self) -> int:
         return len(self._free)
 
+    @property
+    def logical_blocks(self) -> int:
+        """Sum of refcounts: block-table entries across all holders. With
+        sharing this exceeds the physical ``num_blocks - free_blocks``."""
+        return sum(self._refs)
+
+    @property
+    def shared_blocks(self) -> int:
+        """Physical blocks with more than one holder."""
+        return sum(1 for r in self._refs if r > 1)
+
+    def refcount(self, block: int) -> int:
+        if not 0 <= block < self.num_blocks:
+            raise ValueError(f"refcount of invalid block {block}")
+        return self._refs[block]
+
+    def _relieve(self, n: int) -> None:
+        if n > len(self._free) and self.reclaim_cb is not None:
+            self.reclaim_cb(n - len(self._free))
+
     def try_allocate(self, n: int) -> Optional[List[int]]:
         """``allocate`` that reports exhaustion (or an injected allocation
         fault) as ``None`` instead of raising — the serving engine's
         backpressure seam."""
+        self._relieve(n)
         if n > len(self._free):
             return None
         if n > 0:
@@ -55,22 +92,49 @@ class BlockedAllocator:
             if get_fault_injector().should_fail_kv_alloc():
                 return None
         out, self._free = self._free[:n], self._free[n:]
+        for b in out:
+            self._refs[b] = 1
         return out
 
     def allocate(self, n: int) -> List[int]:
+        self._relieve(n)
         if n > len(self._free):
             raise RuntimeError(
                 f"KV cache exhausted: want {n} blocks, {len(self._free)} free")
         out, self._free = self._free[:n], self._free[n:]
+        for b in out:
+            self._refs[b] = 1
         return out
 
-    def free(self, blocks: Sequence[int]) -> None:
+    def retain(self, blocks: Sequence[int]) -> None:
+        """Add one holder to each LIVE block (mapping a cached prefix into
+        a new stream's block table; pinning a block into the prefix
+        index). Retaining a free block is a bug — it would resurrect
+        storage another allocation may already own."""
+        for b in blocks:
+            if not 0 <= b < self.num_blocks:
+                raise ValueError(f"retaining invalid block {b}")
+            if self._refs[b] < 1:
+                raise ValueError(f"retain of free block {b}")
+        for b in blocks:
+            self._refs[b] += 1
+
+    def release(self, blocks: Sequence[int]) -> None:
+        """Drop one holder per block; a block returns to the free list only
+        at refcount zero. Releasing a free block raises — double free is
+        impossible by construction, shared or not."""
         for b in blocks:
             if not 0 <= b < self.num_blocks:
                 raise ValueError(f"freeing invalid block {b}")
-            if b in self._free:
+            if self._refs[b] < 1:
                 raise ValueError(f"double free of block {b}")
-        self._free.extend(blocks)
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                self._free.append(b)
+
+    # the reference's name; every legacy caller (flush/preempt/failover)
+    # routes through the refcounted release
+    free = release
 
 
 @dataclass(eq=False)  # identity semantics: descriptors live in scheduler sets
@@ -82,6 +146,15 @@ class SequenceDescriptor:
     n_cached: int = 0                                 # tokens with KV in cache
     blocks: List[int] = field(default_factory=list)   # owned KV block ids
     last_logits: Optional[np.ndarray] = None          # set when pending drains
+    # --- prefix-cache state (inference/v2/prefix_cache.py) ---------------
+    cached_prefix_len: int = 0  # tokens adopted from the prefix cache at
+    #                             admission (block-aligned; positions/
+    #                             sampling stay exact because token_pos
+    #                             continues from n_cached)
+    history: List[int] = field(default_factory=list)  # tokens committed to
+    #                             KV, in position order (prefix-hash input)
+    block_hashes: List[bytes] = field(default_factory=list)  # chained hash
+    #                             per FULL block (prefix-trie keys)
     last_scheduled: int = -1   # engine forward-tick of the last chunk (LRU
     #                            eviction + prefill round-robin fairness)
     # --- SLA budget (serving.py admission gate / scheduler slack ordering).

@@ -63,8 +63,21 @@ _RECOVERY_COUNTERS = {"replays": "Serve/recovery.replays",
 SERVE_RECOVERY = (_RECOVERY_COUNTERS["replays"],
                   _RECOVERY_COUNTERS["replay_sheds"],
                   "Serve/recovery.serve_hang_aborts")
+#: cross-request prefix cache (``inference/v2/prefix_cache.py`` —
+#: docs/serving.md "prefix reuse"). Full literals on purpose: the static
+#: event-name lint resolves each against the registry.
+_PREFIX_COUNTERS = {"hits": "Serve/prefix.hits",
+                    "misses": "Serve/prefix.misses",
+                    "tokens_saved": "Serve/prefix.tokens_saved",
+                    "blocks_shared": "Serve/prefix.blocks_shared",
+                    "cow_copies": "Serve/prefix.cow_copies"}
+SERVE_PREFIX = (_PREFIX_COUNTERS["hits"], _PREFIX_COUNTERS["misses"],
+                _PREFIX_COUNTERS["tokens_saved"],
+                _PREFIX_COUNTERS["blocks_shared"],
+                _PREFIX_COUNTERS["cow_copies"],
+                "Serve/prefix.hit_ratio", "Serve/prefix.pinned_blocks")
 SERVE_EVENT_NAMES = (SERVE_COUNTERS + SERVE_GAUGES + SERVE_HISTOGRAMS
-                     + SERVE_RECOVERY)
+                     + SERVE_RECOVERY + SERVE_PREFIX)
 
 
 class Ewma:
@@ -244,6 +257,18 @@ class ServingSession:
         self._stall_rounds = 0     # consecutive no-progress rounds
         self._rng = rng if rng is not None else \
             jax.random.PRNGKey(engine.config.seed + 1)
+        # cross-request prefix reuse (docs/serving.md "prefix reuse"): the
+        # policy owns the knobs, the engine owns the cache — installing is
+        # idempotent, so a recovered session reuses the warm index
+        pc_cfg = self.policy.prefix_cache
+        if pc_cfg and pc_cfg.get("enabled", True):
+            engine.install_prefix_cache(
+                scope=pc_cfg.get("scope", "tenant"),
+                min_block_hits=int(pc_cfg.get("min_block_hits", 1)),
+                max_pinned_blocks=pc_cfg.get("max_pinned_blocks"))
+        # registry counters are monotone increments; the cache keeps plain
+        # totals — this snapshot turns totals into deltas at flush time
+        self._prefix_reported: Dict[str, int] = {}
         if self.policy.telemetry:
             from ...monitor.telemetry import metrics_registry as _mr
 
@@ -389,8 +414,13 @@ class ServingSession:
         # heuristic would shed every replay after the first one re-fills
         # the engine. "Provably unmeetable" here means even an idle engine
         # cannot deliver the rate.
+        # a replayed context is prime prefix-cache material: the donor
+        # incarnation's committed blocks (or a sibling stream's) make the
+        # re-prefill a block-table copy up to the first uncached token
         decision = "admit" if uid in self.eng.check_schedule(
-            [uid], [req.n_prefill]).admitted else "queue"
+            [uid], [req.n_prefill],
+            cached_prefix={uid: self._peek_prefix(req)}).admitted \
+            else "queue"
         if self.policy.admission != "none" and req.rate_sla > 0 \
                 and self.capacity.decode_tok_s_best \
                 < self.policy.rate_feasibility_margin * req.rate_sla:
@@ -413,10 +443,24 @@ class ServingSession:
             self._count("queued")
         return "replayed"
 
+    def _peek_prefix(self, req: _Request) -> int:
+        """Cached-prefix length for ``req``'s full context, side-effect
+        free (no counters, no recency) — the gate prices prefill at the
+        NOVEL tokens only; the request may still be shed."""
+        pc = self.eng.prefix_cache
+        if pc is None:
+            return 0
+        return pc.peek(req.tokens + req.out, req.tenant)
+
     def _gate(self, req: _Request, now: float, ahead_tokens: int = 0) -> str:
         """admit | queue | shed for one request against the capacity model
-        and the engine's structural limits."""
-        res = self.eng.check_schedule([req.uid], [req.n_prefill])
+        and the engine's structural limits. Prefill cost — both the KV
+        block demand and the TTFT projection — is priced at
+        ``n_prefill − cached_prefix_len``: a cached prefix is a
+        block-table copy, not a forward."""
+        cached = self._peek_prefix(req)
+        res = self.eng.check_schedule([req.uid], [req.n_prefill],
+                                      cached_prefix={req.uid: cached})
         structural_ok = req.uid in res.admitted
         if self.policy.admission == "none":
             return "admit" if structural_ok else "queue"
@@ -445,7 +489,7 @@ class ServingSession:
             slot_wait = 0.0 if structural_ok else self._slot_wait_s()
             eta = self.policy.sla_headroom * self.capacity.prefill_eta_s(
                 self._prefill_backlog_tokens() + ahead_tokens
-                + req.n_prefill, best=idle)
+                + req.n_prefill - cached, best=idle)
             if now + slot_wait + eta > req.deadline_s:
                 return "shed"
         if not structural_ok:
@@ -465,8 +509,13 @@ class ServingSession:
             # deadline (hugely negative slack) and the slack eviction
             # policies re-victimize the very stream we chose to resume
             first_token_s=req.first_token_s)
-        d.pending.extend(int(t) for t in req.tokens)
-        d.pending.extend(int(t) for t in req.out)
+        # probe the prefix cache with the FULL context (prompt + emitted
+        # prefix): an admission, a requeue after eviction and a crash
+        # replay all re-enter here, so all three skip straight to the
+        # first uncached token when the blocks are still indexed
+        ctx = [int(t) for t in req.tokens] + [int(t) for t in req.out]
+        cached = self.eng.map_cached_prefix(req.uid, ctx)
+        d.pending.extend(ctx[cached:])
         d.last_logits = None
         req.enqueue_s = now
         self.running[req.uid] = req
@@ -768,10 +817,22 @@ class ServingSession:
                     self.capacity.record_prefill(len(req.tokens),
                                                  t1 - req.enqueue_s)
 
+    def _exclusive_blocks(self, uid: int) -> int:
+        """Blocks only ``uid`` holds (refcount 1): preempting it frees
+        exactly these — shared blocks stay alive under their other holders
+        (sibling streams or the prefix index), so they buy no relief."""
+        alloc = self.eng.allocator
+        blocks = self.eng.seqs[uid].blocks
+        if not hasattr(alloc, "refcount"):
+            return len(blocks)
+        return sum(1 for b in blocks if alloc.refcount(b) == 1)
+
     def _eviction_victim(self, now: float) -> Optional[int]:
         """Lowest slack first — the stream most likely to miss its SLA
-        anyway; ties (e.g. every stream slack-less) break toward the
-        longest context, whose blocks buy the most relief."""
+        anyway; ties (e.g. every stream slack-less) break toward the most
+        EXCLUSIVE (unshared) blocks, which buy the most actual relief —
+        a stream riding a hot shared prefix frees almost nothing — then
+        toward the longest context."""
         live = [u for u in self.running if u in self.eng.seqs
                 and self.eng.seqs[u].blocks]
         if not live:
@@ -779,6 +840,7 @@ class ServingSession:
         return min(live, key=lambda u: (
             slack_of(self.eng.seqs[u], now, self.capacity.prefill_tok_s,
                      self.capacity.decode_tok_s),
+            -self._exclusive_blocks(u),
             -self.eng.seqs[u].n_cached))
 
     def _evict(self, uid: int, now: float, events: List[ServeEvent]) -> None:
@@ -859,22 +921,45 @@ class ServingSession:
         self._metrics.gauge("Serve/queue_depth").set(len(self.queue))
         self._metrics.gauge("Serve/kv_occupancy").set(self._kv_occupancy())
         self._metrics.gauge("Serve/live_seqs").set(len(self.running))
+        pc = self.eng.prefix_cache
+        if pc is not None:
+            # the cache keeps lifetime totals; registry counters take the
+            # delta since the last flush (monotone either way)
+            for key, metric in _PREFIX_COUNTERS.items():
+                delta = pc.counters[key] - self._prefix_reported.get(key, 0)
+                if delta:
+                    self._metrics.counter(metric).incr(delta)
+                    self._prefix_reported[key] = pc.counters[key]
+            self._metrics.gauge("Serve/prefix.hit_ratio").set(pc.hit_ratio)
+            self._metrics.gauge("Serve/prefix.pinned_blocks").set(
+                pc.pinned_blocks)
 
     # ------------------------------------------------------------ reporting
     @property
     def idle(self) -> bool:
         return not self.running and not self.queue
 
+    def prefix_stats(self) -> Optional[Dict[str, float]]:
+        """Prefix-cache counters + hit ratio (None when no cache is
+        installed) — what the fleet router joins with its placement-side
+        ``Fleet/affinity_hits`` for REALIZED reuse."""
+        pc = self.eng.prefix_cache
+        return None if pc is None else pc.stats()
+
     def stats(self) -> Dict[str, float]:
         """Counters + instantaneous state, for bench lines and operators."""
-        return {**self.counters,
-                **{f"recovery_{n}": v
-                   for n, v in self.recovery_counters.items()},
-                "queue_depth": len(self.queue),
-                "live_seqs": len(self.running),
-                "kv_occupancy": round(self._kv_occupancy(), 4),
-                "prefill_tok_s_est": round(self.capacity.prefill_tok_s, 1),
-                "decode_step_s_est": round(self.capacity.decode_step_s, 5)}
+        out = {**self.counters,
+               **{f"recovery_{n}": v
+                  for n, v in self.recovery_counters.items()},
+               "queue_depth": len(self.queue),
+               "live_seqs": len(self.running),
+               "kv_occupancy": round(self._kv_occupancy(), 4),
+               "prefill_tok_s_est": round(self.capacity.prefill_tok_s, 1),
+               "decode_step_s_est": round(self.capacity.decode_step_s, 5)}
+        ps = self.prefix_stats()
+        if ps is not None:
+            out.update({f"prefix_{k}": v for k, v in ps.items()})
+        return out
 
     def summary_events(self, step: Optional[int] = None) -> List[Tuple]:
         """Scalar ``Serve/*`` events for a MonitorMaster print boundary —
@@ -896,6 +981,15 @@ class ServingSession:
                ("Serve/queue_depth", float(len(self.queue)), step),
                ("Serve/live_seqs", float(len(self.running)), step),
                ("Serve/kv_occupancy", self._kv_occupancy(), step)]
+        # getattr chain: skeleton sessions (offline renderers, report
+        # tests) carry no engine at all
+        pc = getattr(getattr(self, "eng", None), "prefix_cache", None)
+        if pc is not None:
+            ev += [(_PREFIX_COUNTERS[n], float(pc.counters[n]), step)
+                   for n in _PREFIX_COUNTERS]
+            ev += [("Serve/prefix.hit_ratio", float(pc.hit_ratio), step),
+                   ("Serve/prefix.pinned_blocks",
+                    float(pc.pinned_blocks), step)]
         if self._metrics is not None:
             for name in SERVE_HISTOGRAMS:
                 hist = self._metrics.histogram(name)

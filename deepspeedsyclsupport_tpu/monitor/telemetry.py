@@ -163,6 +163,15 @@ EVENT_NAMES = frozenset(
      "Serve/recovery.replays", "Serve/recovery.replay_sheds",
      "Serve/recovery.serve_hang_aborts",
      "Serve/recovery.time_to_recover_s",
+     # cross-request KV prefix cache (inference/v2/prefix_cache.py;
+     # docs/serving.md "prefix reuse", semantics in docs/observability.md):
+     # admission-probe hit/miss counters, prefill tokens skipped, physical
+     # blocks mapped into more than one block table, copy-on-write
+     # unshares, plus the hit-ratio / pinned-block gauges
+     "Serve/prefix.hits", "Serve/prefix.misses",
+     "Serve/prefix.tokens_saved", "Serve/prefix.blocks_shared",
+     "Serve/prefix.cow_copies", "Serve/prefix.hit_ratio",
+     "Serve/prefix.pinned_blocks",
      # serving fleet control plane (inference/v2/fleet — router edge
      # admission, affinity placement, journal-based cross-replica
      # failover; docs/serving.md "fleet control plane"): routed/shed/

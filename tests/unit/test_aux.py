@@ -447,10 +447,11 @@ class TestBenchLadder:
         # CPU-sim pod decomposition / beyond-HBM rungs) ride at the tail
         # of both plans
         assert rungs == ["probe", "kernels_micro", "kernels", "train",
-                         "serve", "serve_fused", "serve_goodput",
-                         "multichip", "offload", "fleet", "train_ring"]
+                         "serve", "serve_fused", "serve_prefix",
+                         "serve_goodput", "multichip", "offload", "fleet",
+                         "train_ring"]
         # kernels timed out → remaining rungs run pinned to CPU
-        for i in (3, 4, 5, 6, 7, 8, 9, 10):
+        for i in (3, 4, 5, 6, 7, 8, 9, 10, 11):
             assert seen[i][1].get("JAX_PLATFORMS") == "cpu"
         lines = capsys.readouterr().out.strip().splitlines()
         head = _json.loads(lines[-1])

@@ -269,6 +269,40 @@ def serve_recovery_summary(records: List[Dict[str, Any]]) -> List[str]:
     return lines
 
 
+def serve_prefix_summary(records: List[Dict[str, Any]]) -> List[str]:
+    """``Serve/prefix.*`` view: cross-request KV prefix-cache reuse.
+    Latest scalar values come from metric records, falling back to the last
+    dump marker's registry snapshot; the hit ratio is recomputed from the
+    final hit/miss totals so it reflects the whole stream, not the last
+    flush window."""
+    latest: Dict[str, Any] = {}
+    for r in records:
+        name = str(r.get("name", ""))
+        if r.get("kind") == "metric" and name.startswith(
+                "Serve/prefix."):  # dslint: allow(undeclared-event-name) read-side filter
+            latest[name] = latest.get(name, 0) + r.get("value", 0) \
+                if name.rsplit(".", 1)[-1] not in ("hit_ratio",
+                                                   "pinned_blocks") \
+                else r.get("value")
+        if r.get("kind") == "dump":
+            metrics = (r.get("data") or {}).get("metrics", {})
+            for section in ("counters", "gauges"):
+                for k, v in metrics.get(section, {}).items():
+                    if k.startswith("Serve/prefix."):  # dslint: allow(undeclared-event-name) read-side filter
+                        latest[k] = v
+    if not latest:
+        return []
+    lines = ["prefix reuse (Serve/prefix.*)"]
+    hits = float(latest.get("Serve/prefix.hits", 0) or 0)
+    misses = float(latest.get("Serve/prefix.misses", 0) or 0)
+    if hits + misses > 0:
+        lines.append(f"  hit ratio: {hits / (hits + misses):.3f} "
+                     f"({int(hits)} hit(s) / {int(hits + misses)} lookup(s))")
+    for name in sorted(latest):
+        lines.append(f"  {name} = {latest[name]}")
+    return lines
+
+
 def health_summary(records: List[Dict[str, Any]]) -> List[str]:
     """Training-health view from ``health/step`` records
     (``runtime/sentinel.py`` verdict shape via ``Telemetry.record_health``):
@@ -533,6 +567,10 @@ def render(paths: List[str], last: int = 20) -> Optional[str]:
     if recovery:
         out.append("")
         out.extend(recovery)
+    prefix = serve_prefix_summary(all_records)
+    if prefix:
+        out.append("")
+        out.extend(prefix)
     if len(per_rank) > 1:
         out.append("")
         out.extend(straggler_summary(per_rank))
