@@ -940,6 +940,25 @@ def run_offload():
 # ======================================================================
 # rung: serve (FastGen-style TTFT / throughput, SplitFuse A-B)
 # ======================================================================
+def _request_waterfall(session_traces, router_records=()):
+    """Per-load-point request-time attribution (``detail.request_waterfall``):
+    join the in-memory trace rings drained from the point's sessions (plus
+    the router's, in the fleet rung) through ``monitor.reqtrace`` and
+    compact the payload for a bench line — ``bench_diff`` gates the
+    per-stage TTFT p95s in it."""
+    from deepspeedsyclsupport_tpu.monitor import reqtrace
+
+    try:
+        att = reqtrace.waterfall(
+            [(rid, "", list(recs)) for rid, recs in session_traces],
+            router_records=list(router_records))
+    except Exception as e:  # attribution is a detail, never the rung
+        return {"error": str(e)[:200]}
+    att["slo_burn"].pop("windows", None)  # per-window rows are report fuel
+    att["worst"] = att["worst"][:3]
+    return att
+
+
 def _drive_serving(eng, prompts, n_clients, reqs_per_client, gen_len, mode,
                    uid_base, arrival_of=None, deadline=None):
     """Closed-loop clients over the v2 engine at single-forward granularity.
@@ -1231,6 +1250,7 @@ def _drive_serving_sla(eng, prompts, n_clients, reqs_per_client, gen_len,
     sess = ServingSession(eng, pol, capacity=capacity)
     crashed = False
     recovery_summary = None
+    trace_records = []
 
     ttfts, itls = [], []
     submitted, last_tok, gen_count, ttft_of = {}, {}, {}, {}
@@ -1322,6 +1342,10 @@ def _drive_serving_sla(eng, prompts, n_clients, reqs_per_client, gen_len,
 
             crashed = True
             eng.flush(list(eng.seqs))   # KV state + descriptors lost
+            # the dead incarnation's trace ring survives the crash (it is
+            # host memory, like the journal is disk) — bank it for the
+            # point's request waterfall before the session goes away
+            trace_records.extend(sess.drain_trace())
             sess.close()
             states, last_t = load_journal(journal_dir)
             sess = ServingSession(
@@ -1367,6 +1391,8 @@ def _drive_serving_sla(eng, prompts, n_clients, reqs_per_client, gen_len,
             "replays": len(recovery_summary["replayed"]),
             "replay_sheds": len(recovery_summary["shed"]),
             "time_to_recover_s": recovery_summary["time_to_recover_s"]}
+    trace_records.extend(sess.drain_trace())
+    res["trace"] = trace_records
     if journal_dir is not None:
         sess.close()
     return res
@@ -1605,6 +1631,11 @@ def _serve_goodput_once(model_name, platform, *, client_sweep,
                 break
             gp, miss = _goodput(r.pop("req_stats"), sla_rate, ttft_sla,
                                 r["wall_s"])
+            if mode == "splitfuse":
+                # per-load-point request-time attribution off the SLA
+                # arm's in-memory trace ring (no disk IO in the timed path)
+                point["request_waterfall"] = _request_waterfall(
+                    [("0", r.pop("trace", []))])
             point[mode] = {"goodput_tok_s": round(gp, 2),
                            "sla_miss_pct": round(100 * miss, 1),
                            "shed_pct": r.get("serve", {}).get("shed_pct",
@@ -1689,6 +1720,7 @@ def _serve_goodput_once(model_name, platform, *, client_sweep,
                     rate_sla=sla_rate, capacity=capacity)
                 ff_gp, _ = _goodput(ff_r.pop("req_stats"), sla_rate,
                                     ttft_sla, ff_r["wall_s"])
+                ff_r.pop("trace", None)
                 r = _drive_serving_sla(
                     eng, prompts_for(uid_base, n_av, av_reqs),
                     n_av, av_reqs,
@@ -1702,6 +1734,10 @@ def _serve_goodput_once(model_name, platform, *, client_sweep,
             availability = {
                 "clients": n_av, "reqs_per_client": av_reqs,
                 "crash_at_tokens": crash_tokens,
+                # the trace spans BOTH incarnations: replay segments and
+                # requeue waits show up as their own stages
+                "request_waterfall": _request_waterfall(
+                    [("0", r.pop("trace", []))]),
                 "goodput_fault_free": round(ff_gp, 2),
                 "goodput_with_recovery": round(gp, 2),
                 "availability_ratio": round(gp / max(ff_gp, 1e-9), 3),
@@ -2099,6 +2135,14 @@ def run_fleet():
                 "realized_reuse": {
                     k: v for k, v in (fl.get("realized_reuse") or {}).items()
                     if k != "per_replica"},
+                # fleet-wide request waterfall for THIS point: every
+                # replica's trace ring (the killed one's ring survives the
+                # kill — host memory, like its journal survives on disk)
+                # joined with the router's stream on one wall-clock base
+                "request_waterfall": _request_waterfall(
+                    [(rid, rep.session.drain_trace())
+                     for rid, rep in replicas.items()],
+                    router_records=router.drain_trace()),
             }
             points.append(point)
             # flush NOW: a later kill cannot take the completed point back
@@ -2184,7 +2228,8 @@ def _drive_prefix_arm(eng, prefix_cache, prompts, gen_len, deadline=None):
             raise RuntimeError(f"serve_prefix arm stalled: {sess.stats()}")
     return {"outs": outs, "ttft": ttft,
             "wall_s": time.perf_counter() - t0,
-            "serve": sess.stats(), "prefix": sess.prefix_stats()}
+            "serve": sess.stats(), "prefix": sess.prefix_stats(),
+            "trace": sess.drain_trace()}
 
 
 def _serve_prefix_once(model_name, platform, *, load_sweep, system_len,
@@ -2285,6 +2330,10 @@ def _serve_prefix_once(model_name, platform, *, load_sweep, system_len,
             "cow_copies": ps.get("cow_copies", 0),
             "shed_off": arms["off"]["serve"].get("shed", 0),
             "shed_on": arms["on"]["serve"].get("shed", 0),
+            # cached-arm attribution: the cached_prefix mean + prefill-stage
+            # quantiles are where the TTFT speedup must show up
+            "request_waterfall": _request_waterfall(
+                [("on", arms["on"].pop("trace", []))]),
         }
         points.append(point)
         _emit({"metric": f"serve_prefix_point_{model_name}",

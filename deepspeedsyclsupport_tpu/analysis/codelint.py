@@ -330,6 +330,66 @@ class UndeclaredRegionName(Rule):
                     f"not in monitor/mfu.py SCOPE_REGIONS", snippet)
 
 
+class UndeclaredStageName(Rule):
+    name = "undeclared-stage-name"
+    description = ("request-lifecycle stage literals (ServingSession._stage /"
+                   " RequestJournal.stage / note_stage calls and "
+                   "{'stage': ...} record payloads) must resolve against "
+                   "monitor/reqtrace.py's stage registries — a typo'd stage "
+                   "silently orphans its interval as 'unattributed' in every "
+                   "request waterfall")
+
+    STAGE_CALLS = ("stage", "_stage", "note_stage")
+
+    def __init__(self):
+        from ..monitor.reqtrace import FLEET_STAGES, SERVE_STAGES
+
+        self._stages = set(SERVE_STAGES) | set(FLEET_STAGES)
+
+    def _literals(self, node):
+        """String constants reachable from a stage argument (plain literal
+        or the branches of a conditional expression)."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            yield node.value, node.lineno
+        elif isinstance(node, ast.IfExp):
+            yield from self._literals(node.body)
+            yield from self._literals(node.orelse)
+
+    def check(self, relpath, tree, source_lines):
+        if relpath.startswith(("tests/", "docs/")):
+            return
+        docstrings = _docstring_linenos(tree)
+
+        def _flag(value, lineno, where):
+            if value in self._stages or lineno in docstrings:
+                return None
+            if _suppressed(source_lines, lineno, self.name):
+                return None
+            snippet = source_lines[lineno - 1].strip() \
+                if lineno <= len(source_lines) else ""
+            return Violation(
+                self.name, relpath, lineno,
+                f"stage {value!r} ({where}) is not declared in "
+                f"monitor/reqtrace.py SERVE_STAGES/FLEET_STAGES — the "
+                f"join would bucket its time as 'unattributed' (typo, or "
+                f"declare the stage + its Serve/stage.* event)", snippet)
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and len(node.args) >= 2 and \
+                    _call_name(node).split(".")[-1] in self.STAGE_CALLS:
+                for value, lineno in self._literals(node.args[1]):
+                    v = _flag(value, lineno, "stage call")
+                    if v is not None:
+                        yield v
+            elif isinstance(node, ast.Dict):
+                for k, val in zip(node.keys, node.values):
+                    if isinstance(k, ast.Constant) and k.value == "stage":
+                        for value, lineno in self._literals(val):
+                            v = _flag(value, lineno, "record payload")
+                            if v is not None:
+                                yield v
+
+
 def _docstring_linenos(tree: ast.AST) -> Set[int]:
     """Line ranges of every docstring (multi-line strings included)."""
     out: Set[int] = set()
@@ -430,7 +490,7 @@ class HostSyncInStepPath(Rule):
 
 ALL_RULES: Sequence[Callable[[], Rule]] = (
     SignalHandlerSafety, UndeclaredEventName, UndeclaredRegionName,
-    WallClockInStepPath, HostSyncInStepPath)
+    UndeclaredStageName, WallClockInStepPath, HostSyncInStepPath)
 
 
 # -------------------------------------------------------------------- runner

@@ -191,6 +191,14 @@ class ServingPolicyConfig:
     #                      (default 1 — pin on first commit)
     #   max_pinned_blocks: index pin cap (default: half the KV pool)
     prefix_cache: Optional[Dict[str, Any]] = None
+    # --- request-time attribution (docs/observability.md) ---------------
+    # serve/stage lifecycle records in the journal + the in-memory
+    # trace_log ring monitor/reqtrace.py joins into per-request waterfalls
+    trace_stages: bool = True
+    # SLO burn accounting (Serve/slo.* gauges): sliding-window length and
+    # the error budget the burn rate is priced against (miss_frac/budget)
+    slo_window_s: float = 60.0
+    slo_budget: float = 0.05
     extra: Dict[str, Any] = field(default_factory=dict)  # forward-compat bag
 
     def __post_init__(self):
@@ -229,6 +237,12 @@ class ServingPolicyConfig:
         if self.stall_patience_rounds < 1:
             raise ValueError(f"stall_patience_rounds must be >= 1, got "
                              f"{self.stall_patience_rounds}")
+        if self.slo_window_s <= 0:
+            raise ValueError(f"slo_window_s must be > 0, got "
+                             f"{self.slo_window_s}")
+        if not 0.0 < self.slo_budget <= 1.0:
+            raise ValueError(f"slo_budget must be in (0, 1], got "
+                             f"{self.slo_budget}")
         if self.prefix_cache is not None:
             known = {"enabled", "scope", "min_block_hits",
                      "max_pinned_blocks"}

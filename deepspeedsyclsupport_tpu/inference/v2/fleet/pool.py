@@ -234,6 +234,9 @@ class ProcessReplica(ReplicaEndpoint):
     # ------------------------------------------------------------ transport
     def _spool(self, payload: Dict[str, Any]) -> None:
         self._seq += 1
+        # stamp the hand-off time: the worker's `spool_wait` stage is the
+        # gap between this write and its admit-side pickup
+        payload = {**payload, "spooled_t": time.time()}  # dslint: allow(wall-clock-in-step-path) cross-process spool latency
         name = f"req_{self._seq:06d}_{payload['uid']}.json"
         _atomic_write_json(os.path.join(self.spool_dir, name), payload)
 

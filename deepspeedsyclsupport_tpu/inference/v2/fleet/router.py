@@ -18,7 +18,9 @@ monotonic clock does not survive a process boundary — the same tradeoff
 ``supervisor.recover_requests`` documents.
 """
 import hashlib
+import os
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -39,7 +41,9 @@ _FAILOVER_COUNTERS = {"deaths": "Fleet/failover.deaths",
 FLEET_FAILOVER = (_FAILOVER_COUNTERS["deaths"],
                   _FAILOVER_COUNTERS["replays"],
                   _FAILOVER_COUNTERS["replay_sheds"])
-FLEET_GAUGES = ("Fleet/replicas_ready", "Fleet/inflight")
+FLEET_GAUGES = ("Fleet/replicas_ready", "Fleet/inflight",
+                "Fleet/slo.ttft_miss_frac", "Fleet/slo.shed_frac",
+                "Fleet/slo.burn_rate")
 FLEET_HISTOGRAMS = ("Fleet/routed_ttft_s",)
 FLEET_EVENT_NAMES = (FLEET_COUNTERS + FLEET_FAILOVER + FLEET_GAUGES
                      + FLEET_HISTOGRAMS)
@@ -93,6 +97,10 @@ class FleetConfig:
     #: ``fleet/failover`` records + the final metrics dump) — what
     #: ``tools/trace_report.py --fleet`` reads. None = no stream.
     log_path: Optional[str] = None
+    #: sliding window (s) for the ``Fleet/slo.*`` burn gauges
+    slo_window_s: float = 60.0
+    #: allowed bad-request fraction in the window; burn = worst_frac / budget
+    slo_budget: float = 0.05
 
     def __post_init__(self):
         if self.admission not in ("sla", "none"):
@@ -104,6 +112,12 @@ class FleetConfig:
         if self.dead_after_s <= 0:
             raise ValueError(f"dead_after_s must be > 0, got "
                              f"{self.dead_after_s}")
+        if self.slo_window_s <= 0:
+            raise ValueError(f"slo_window_s must be > 0, got "
+                             f"{self.slo_window_s}")
+        if not 0 < self.slo_budget <= 1:
+            raise ValueError(f"slo_budget must be in (0, 1], got "
+                             f"{self.slo_budget}")
 
 
 class ReplicaEndpoint:
@@ -303,6 +317,13 @@ class FleetRouter:
         self.flights: Dict[int, _Flight] = {}
         self._sticky: Dict[str, str] = {}
         self._dead: set = set()
+        #: in-memory mirror of the router stream — journal-record-shaped
+        #: dicts the bench's per-load-point request-waterfall join drains
+        #: (``monitor.reqtrace`` reads the same shape off disk)
+        self.trace_log: deque = deque(maxlen=65536)
+        self._slo_ttft: deque = deque()   # (t, ok) at first token
+        self._slo_shed: deque = deque()   # (t, shed) at edge verdict
+        self._poll_n = 0
         self.counters: Dict[str, int] = {
             "routed": 0, "shed": 0, "completed": 0, "affinity_hits": 0}
         self.failover_counters: Dict[str, int] = {
@@ -332,8 +353,28 @@ class FleetRouter:
 
     # ------------------------------------------------------------- plumbing
     def _record(self, name: str, data: Dict[str, Any]) -> None:
+        # the in-memory ring always mirrors the stream (the bench joins it
+        # without a log_path); the flight recorder only when configured
+        self.trace_log.append({"name": name, "t": self.clock(),
+                               "data": dict(data)})
         if self._rec is not None:
             self._rec.record("event", name, data=data)
+
+    def _stage(self, uid: int, stage: str, **data: Any) -> None:
+        """Stamp one ``fleet/stage`` lifecycle record (uid −1 = fleet
+        scope). Stage names are validated against the
+        ``monitor.reqtrace`` registry — the join refuses typos."""
+        from ....monitor.reqtrace import check_stage
+
+        check_stage(stage, fleet=True)
+        self._record("fleet/stage", {"uid": int(uid), "stage": stage,
+                                     **data})
+
+    def drain_trace(self) -> List[Dict[str, Any]]:
+        """Return and clear the in-memory router stream mirror."""
+        out = list(self.trace_log)
+        self.trace_log.clear()
+        return out
 
     def _count(self, name: str, n: int = 1) -> None:
         self.counters[name] = self.counters.get(name, 0) + n
@@ -349,6 +390,10 @@ class FleetRouter:
     def close(self) -> None:
         """Flush the router stream (metrics snapshot included) — idempotent."""
         if self._rec is not None:
+            try:
+                self.export_metrics()
+            except Exception:
+                pass
             try:
                 self._rec.dump("fleet_close")
             except Exception:
@@ -429,6 +474,8 @@ class FleetRouter:
                     for _rid, v in views)
                 if eta > req.ttft_sla_s:
                     return self._edge_shed(req, now, "deadline_unmeetable")
+        self._stage(req.uid, "edge_gate", verdict="admit",
+                    n_prompt=len(req.tokens))
         key = self._affinity_key(req)
         sticky = self._sticky.get(key) if key is not None else None
         rid = self.placement(req, views, sticky)
@@ -438,11 +485,14 @@ class FleetRouter:
             self._count("affinity_hits")
         if key is not None:
             self._sticky[key] = rid
+        self._stage(req.uid, "placement", replica=rid,
+                    sticky=bool(rid == sticky))
         outcome = self.replicas[rid].submit(req)
         if outcome == "shed":
             # replica-local gate disagreed (structural edge case): terminal
             self._count("shed")
             self.per_replica[rid]["shed"] += 1
+            self._slo_shed.append((now, True))
             self._record("fleet/shed", {"uid": req.uid, "replica": rid,
                                         "reason": "replica_gate"})
             return "shed", rid
@@ -450,6 +500,7 @@ class FleetRouter:
                                         routed_t=now)
         self._count("routed")
         self.per_replica[rid]["routed"] += 1
+        self._slo_shed.append((now, False))
         self._record("fleet/route",
                      {"uid": req.uid, "replica": rid, "tenant": req.tenant,
                       **({"key": key} if key is not None else {})})
@@ -458,6 +509,8 @@ class FleetRouter:
     def _edge_shed(self, req: FleetRequest, now: float,
                    reason: str) -> Tuple[str, Optional[str]]:
         self._count("shed")
+        self._slo_shed.append((now, True))
+        self._stage(req.uid, "edge_gate", verdict="shed", reason=reason)
         self._record("fleet/shed", {"uid": req.uid, "reason": reason})
         return "shed", None
 
@@ -479,7 +532,10 @@ class FleetRouter:
             if rid in self._dead or not r.dead():
                 continue
             out.extend(self.failover(rid, now))
-        self._flush_gauges()
+        self._flush_gauges(now)
+        self._poll_n += 1
+        if self.cfg.log_path and self._poll_n % 512 == 0:
+            self.export_metrics()
         return out
 
     def _ingest(self, rid: str, ev: FleetEvent, now: float) -> None:
@@ -494,6 +550,10 @@ class FleetRouter:
                     len(fl.req.tokens), max(ev.t - fl.routed_t, 1e-9))
                 if fl.replays == 0:
                     self._observe("Fleet/routed_ttft_s", ev.t - fl.routed_t)
+                    if fl.req.ttft_sla_s is not None:
+                        self._slo_ttft.append(
+                            (ev.t,
+                             ev.t - fl.routed_t <= fl.req.ttft_sla_s))
             elif fl.last_emit_t is not None:
                 self.caps[rid].record_decode(
                     len(ev.tokens), max(ev.t - fl.last_emit_t, 1e-9))
@@ -554,6 +614,8 @@ class FleetRouter:
             from .failover import claim_uids
 
             claim_uids(ep.journal_dir, lost, claimer="router")
+        self._stage(-1, "failover_claim", replica=replica_id,
+                    claimed=sorted(states), lost_in_transport=sorted(lost))
         events: List[FleetEvent] = []
         for uid in sorted(states):
             st = states[uid]
@@ -602,6 +664,8 @@ class FleetRouter:
         # the survivor from its watermark
         self._count_failover("replays")
         self.per_replica[rid]["failover_in"] += 1
+        self._stage(uid, "replay_segment", replica=rid,
+                    watermark=len(st.out))
         if fl is None:
             fl = _Flight(req=FleetRequest(
                 uid=uid, tokens=list(st.tokens),
@@ -626,11 +690,49 @@ class FleetRouter:
         if self._metrics is not None:
             self._metrics.histogram(name).observe(value)
 
-    def _flush_gauges(self) -> None:
+    def _slo_snapshot(self, now: float) -> Tuple[float, float, float]:
+        """Sliding-window SLO burn: (ttft_miss_frac, shed_frac, burn_rate)
+        over the last ``cfg.slo_window_s`` seconds. Burn is the worse of
+        the two bad-fractions over the configured error budget — >1 means
+        the fleet is spending budget faster than the SLO allows."""
+        cut = now - self.cfg.slo_window_s
+        for dq in (self._slo_ttft, self._slo_shed):
+            while dq and dq[0][0] < cut:
+                dq.popleft()
+        miss = (sum(1 for _t, ok in self._slo_ttft if not ok)
+                / len(self._slo_ttft)) if self._slo_ttft else 0.0
+        shed = (sum(1 for _t, s in self._slo_shed if s)
+                / len(self._slo_shed)) if self._slo_shed else 0.0
+        return miss, shed, max(miss, shed) / self.cfg.slo_budget
+
+    def export_metrics(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the Prometheus textfile snapshot (atomic rename, same
+        contract as the training exporter). Defaults to
+        ``metrics_router.prom`` beside ``cfg.log_path``."""
+        if self._metrics is None:
+            return None
+        if path is None:
+            if not self.cfg.log_path:
+                return None
+            path = os.path.join(os.path.dirname(self.cfg.log_path) or ".",
+                                "metrics_router.prom")
+        from ....monitor.telemetry import export_metrics_textfile
+
+        return export_metrics_textfile(
+            path, self._metrics.snapshot(), labels={"role": "router"},
+            extra_counters={f"fleet_{k}": v for k, v in
+                            self.counters.items()})
+
+    def _flush_gauges(self, now: Optional[float] = None) -> None:
         if self._metrics is None:
             return
         self._metrics.gauge("Fleet/replicas_ready").set(len(self.rotation()))
         self._metrics.gauge("Fleet/inflight").set(len(self.flights))
+        if now is not None:
+            miss, shed, burn = self._slo_snapshot(now)
+            self._metrics.gauge("Fleet/slo.ttft_miss_frac").set(miss)
+            self._metrics.gauge("Fleet/slo.shed_frac").set(shed)
+            self._metrics.gauge("Fleet/slo.burn_rate").set(burn)
         for rid, r in self.replicas.items():
             ld = r.load()
             self._metrics.gauge(f"Fleet/replica.{rid}.live").set(ld["live"])
@@ -708,6 +810,10 @@ class FleetRouter:
                for n, v in self.failover_counters.items()]
         ev += [("Fleet/replicas_ready", float(len(self.rotation())), step),
                ("Fleet/inflight", float(len(self.flights)), step)]
+        miss, shed, burn = self._slo_snapshot(self.clock())
+        ev += [("Fleet/slo.ttft_miss_frac", miss, step),
+               ("Fleet/slo.shed_frac", shed, step),
+               ("Fleet/slo.burn_rate", burn, step)]
         if self._metrics is not None:
             for name in FLEET_HISTOGRAMS:
                 hist = self._metrics.histogram(name)

@@ -32,6 +32,7 @@ for the overload-behavior contract and config keys.
 import math
 import os
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -51,8 +52,20 @@ from ...utils.logging import logger
 #: ``monitor.telemetry.EVENT_NAMES`` so ``DSTPU_STRICT_EVENTS=1`` passes).
 SERVE_COUNTERS = ("Serve/admitted", "Serve/queued", "Serve/shed",
                   "Serve/evicted", "Serve/completed")
-SERVE_GAUGES = ("Serve/queue_depth", "Serve/kv_occupancy", "Serve/live_seqs")
-SERVE_HISTOGRAMS = ("Serve/ttft_s", "Serve/itl_s",
+#: sliding-window SLO burn gauges (request-time attribution,
+#: docs/observability.md): TTFT-SLA miss fraction, shed fraction, and
+#: max(miss, shed)/error-budget burn rate over ``policy.slo_window_s``
+SERVE_SLO_GAUGES = ("Serve/slo.ttft_miss_frac", "Serve/slo.shed_frac",
+                    "Serve/slo.burn_rate")
+SERVE_GAUGES = ("Serve/queue_depth", "Serve/kv_occupancy",
+                "Serve/live_seqs") + SERVE_SLO_GAUGES
+#: ``Serve/queue_wait_s`` is the satellite admission→prefill-dispatch wait;
+#: the ``Serve/stage.*_s`` pair are the per-request prefill/decode phase
+#: self-times observed at close — all surface p50/p95/p99 via
+#: :meth:`ServingSession.summary_events` (quantile members are registry-
+#: enumerated in ``monitor/telemetry.py``)
+SERVE_HISTOGRAMS = ("Serve/ttft_s", "Serve/itl_s", "Serve/queue_wait_s",
+                    "Serve/stage.prefill_s", "Serve/stage.decode_s",
                     "Serve/recovery.time_to_recover_s")
 #: crash-replay recovery family (``inference/v2/supervisor.py`` — journal
 #: replay counters + the stuck-decode watchdog's abort count). Full
@@ -204,6 +217,8 @@ class _Request:
     out: List[int] = field(default_factory=list)  # emitted tokens (requeue)
     enqueue_s: float = 0.0          # when the prompt entered the engine
     queued_s: float = 0.0           # when it (last) entered the queue
+    cached_prefix_len: int = 0      # prefix-cache hit at (last) activation
+    preempted: bool = False         # next activation is a requeue, not fresh
     #: ``tokens`` stays the ORIGINAL prompt forever; a requeued stream's
     #: context is rebuilt as tokens + out at activation (mutating tokens
     #: would duplicate the partial output on a second eviction)
@@ -255,6 +270,21 @@ class ServingSession:
         self._round = 0            # scheduling rounds (watchdog step label)
         self._tokens_emitted = 0   # serve_crash fault trigger input
         self._stall_rounds = 0     # consecutive no-progress rounds
+        # request-time attribution (monitor/reqtrace.py; docs/
+        # observability.md): lifecycle-edge records mirrored into a bounded
+        # in-memory ring so bench load points join per-request waterfalls
+        # with zero disk IO in the measured path (the journal — when
+        # configured — carries the same records durably). The fixed wall
+        # offset maps this session's monotonic clock onto the journal's
+        # wall stamps: every record rides ONE clock base, so the offline
+        # join can order router and replica streams together.
+        self._tracing = bool(self.policy.trace_stages)
+        self.trace_log: deque = deque(maxlen=65536)
+        self._wall0 = time.time() - self.clock()  # dslint: allow(wall-clock-in-step-path)
+        # SLO burn accounting (Serve/slo.* gauges): sliding windows of
+        # (t, first-token-met-SLA) and (t, outcome-was-shed) samples
+        self._slo_ttft: deque = deque()
+        self._slo_gate: deque = deque()
         self._rng = rng if rng is not None else \
             jax.random.PRNGKey(engine.config.seed + 1)
         # cross-request prefix reuse (docs/serving.md "prefix reuse"): the
@@ -310,6 +340,70 @@ class ServingSession:
         if self.journal is not None:
             self.journal.close()
 
+    # ----------------------------------------------- request-time attribution
+    def _trace(self, name: str, t: float, data: Dict[str, Any]) -> None:
+        """Mirror one lifecycle record (journal-record shape) into the
+        in-memory ring, stamped on the session-clock→wall mapping."""
+        if self._tracing:
+            self.trace_log.append(
+                {"name": name, "t": t + self._wall0, "data": data})
+
+    def _stage(self, uid: int, stage: str, t: float,
+               dur: Optional[float] = None, **data: Any) -> None:
+        """``serve/stage`` lifecycle edge: in-memory ring always (when
+        tracing), journal stream when one is configured — same record, one
+        clock base, no second transport."""
+        if not self._tracing:
+            return
+        payload = {"uid": int(uid), "stage": stage,
+                   **({"dur": float(dur)} if dur is not None else {}),
+                   **data}
+        self.trace_log.append(
+            {"name": "serve/stage", "t": t + self._wall0, "data": payload})
+        if self.journal is not None:
+            self.journal.stage(uid, stage, dur=dur, **data)
+
+    def note_stage(self, uid: int, stage: str,
+                   dur: Optional[float] = None, **data: Any) -> None:
+        """Public stamping hook for the owning loop (``serve_worker``
+        stamps ``spool_wait`` through this; a future RPC front-end stamps
+        its ingress edge the same way)."""
+        self._stage(uid, stage, self.clock(), dur=dur, **data)
+
+    def drain_trace(self) -> List[Dict[str, Any]]:
+        """Hand over and clear the in-memory lifecycle records — the bench
+        rungs drain per load point so each point's waterfall joins only its
+        own requests."""
+        out = list(self.trace_log)
+        self.trace_log.clear()
+        return out
+
+    def export_metrics(self, path: str) -> Optional[str]:
+        """Prometheus textfile snapshot of the session's registry (atomic
+        rename — the training exporter's contract). No-op without
+        telemetry."""
+        if self._metrics is None:
+            return None
+        from ...monitor.telemetry import export_metrics_textfile
+
+        return export_metrics_textfile(path, self._metrics.snapshot())
+
+    def _slo_snapshot(self, now: float) -> Tuple[float, float, float]:
+        """(ttft_miss_frac, shed_frac, burn_rate) over the trailing
+        ``policy.slo_window_s`` window. Burn is the worse of the two miss
+        fractions priced against the error budget: burn > 1 means the SLO
+        budget is being spent faster than it accrues."""
+        horizon = now - self.policy.slo_window_s
+        for dq in (self._slo_ttft, self._slo_gate):
+            while dq and dq[0][0] < horizon:
+                dq.popleft()
+        miss = (1.0 - sum(1 for _, ok in self._slo_ttft if ok)
+                / len(self._slo_ttft)) if self._slo_ttft else 0.0
+        shed = (sum(1 for _, s in self._slo_gate if s)
+                / len(self._slo_gate)) if self._slo_gate else 0.0
+        burn = max(miss, shed) / max(self.policy.slo_budget, 1e-9)
+        return miss, shed, burn
+
     # ------------------------------------------------------------- admission
     def submit(self, uid: int, tokens: Sequence[int], max_new_tokens: int,
                *, tenant: str = "default", now: Optional[float] = None,
@@ -342,6 +436,12 @@ class ServingSession:
             # _maintain_queue re-gates in deadline order every round (an
             # urgent arrival still legitimately outranks laxer ones there)
             decision = "queue"
+        # gate-verdict edge: the ONLY trace a shed-at-submit request leaves
+        # (terminal sheds are never journaled as admits), so the waterfall
+        # still counts and names them
+        self._stage(uid, "gate", now, verdict=decision,
+                    n_prompt=len(req.tokens))
+        self._slo_gate.append((now, decision == "shed"))
         if decision == "shed":
             # terminal at submit: the caller learns synchronously, nothing
             # is in flight — so nothing to journal
@@ -353,6 +453,11 @@ class ServingSession:
             self.journal.admit(uid, req.tokens, req.max_new_tokens,
                                tenant=req.tenant, rate_sla=req.rate_sla,
                                ttft_sla_s=ttft)
+        self._trace("serve/admit", now, {
+            "uid": int(uid), "n_tokens": len(req.tokens),
+            "max_new_tokens": req.max_new_tokens, "tenant": req.tenant,
+            "rate_sla": req.rate_sla,
+            **({"ttft_sla_s": float(ttft)} if ttft is not None else {})})
         if decision == "admit":
             self._activate(req, now)
             return "admitted"
@@ -397,6 +502,12 @@ class ServingSession:
                                    tenant=tenant, rate_sla=rate, out=out,
                                    replayed=True)
                 self.journal.close_request(uid, "done")
+            self._trace("serve/admit", now, {
+                "uid": int(uid), "n_tokens": len(tokens),
+                "max_new_tokens": int(max_new_tokens), "tenant": tenant,
+                "replayed": True, "watermark": len(out)})
+            self._trace("serve/close", now,
+                        {"uid": int(uid), "reason": "done"})
             return "completed"
         req = _Request(
             uid=uid, tokens=[int(t) for t in tokens],
@@ -430,11 +541,20 @@ class ServingSession:
             self._count_recovery("replay_sheds")
             if self.journal is not None:
                 self.journal.close_request(uid, "replay_shed")
+            self._trace("serve/close", now,
+                        {"uid": int(uid), "reason": "replay_shed"})
             return "shed"
         if self.journal is not None:
             self.journal.admit(uid, req.tokens, req.max_new_tokens,
                                tenant=tenant, rate_sla=rate, out=out,
                                replayed=True)
+        self._trace("serve/admit", now, {
+            "uid": int(uid), "n_tokens": len(req.tokens),
+            "max_new_tokens": req.max_new_tokens, "tenant": tenant,
+            "rate_sla": rate, "replayed": True, "watermark": len(out)})
+        # replay-segment edge: the survivor side of a failover-spanning
+        # trace (generation/incarnation carried by the journal filename)
+        self._stage(uid, "replay", now, watermark=len(out))
         self._count_recovery("replays")
         if decision == "admit" and not self.queue:
             self._activate(req, now)
@@ -518,6 +638,19 @@ class ServingSession:
         d.pending.extend(ctx[cached:])
         d.last_logits = None
         req.enqueue_s = now
+        req.cached_prefix_len = cached
+        # queue-wait edge (admission→prefill dispatch; the prompt fuses
+        # into the very next forward). A preemption-requeue re-enters here
+        # as requeue_wait so the waterfall separates first-admission queue
+        # time from re-admission backoff; both waits land in the satellite
+        # Serve/queue_wait_s histogram.
+        wait = max(0.0, now - req.queued_s)
+        self._observe("Serve/queue_wait_s", wait)
+        self._stage(req.uid,
+                    "requeue_wait" if req.preempted else "queue_wait",
+                    now, dur=wait, cached_prefix_len=cached,
+                    novel_tokens=len(ctx) - cached)
+        req.preempted = False
         self.running[req.uid] = req
         self._count("admitted")
 
@@ -593,7 +726,7 @@ class ServingSession:
                 wd.disarm(self._round)
             self.eng.slack_policy = None
         self._note_progress(events, dispatches0, now)
-        self._flush_gauges()
+        self._flush_gauges(now)
         return events
 
     def _note_progress(self, events: List[ServeEvent], dispatches0: int,
@@ -660,10 +793,13 @@ class ServingSession:
         completion must see closure for a request they received tokens
         from (one terminal event either way, never both)."""
         self._count("shed")
+        self._slo_gate.append((now, True))
+        close_reason = ("evicted" if req.first_token_s is not None
+                        else f"shed:{reason}")
         if self.journal is not None:
-            self.journal.close_request(
-                req.uid, "evicted" if req.first_token_s is not None
-                else f"shed:{reason}")
+            self.journal.close_request(req.uid, close_reason)
+        self._trace("serve/close", now,
+                    {"uid": int(req.uid), "reason": close_reason})
         if req.first_token_s is not None:
             events.append(ServeEvent("finish", req.uid, now,
                                      reason="evicted"))
@@ -717,6 +853,11 @@ class ServingSession:
         steps = max((len(v) for v in emitted.values()), default=0)
         self.capacity.record_decode(steps, t1 - now)
         self._last_decode_s = t1
+        # one record per scheduling round (uid −1 = session scope; the
+        # scheduled uids ride in data) — per-uid stamps here would double
+        # the journal volume for no join benefit
+        self._stage(-1, "decode_round", t1, dur=t1 - now, mode="fused",
+                    k=steps, uids=sorted(emitted))
         for uid, toks in emitted.items():
             req = self.running[uid]
             req.budget -= len(toks)
@@ -755,6 +896,9 @@ class ServingSession:
             if self._last_decode_s is not None:
                 self.capacity.record_decode(1, t1 - self._last_decode_s)
             self._last_decode_s = t1
+            self._stage(-1, "decode_round", t1, dur=t1 - now,
+                        mode="per_token",
+                        uids=sorted(u for u, _lg in drained))
             for (uid, _lg), tok in zip(drained, toks):
                 tok = int(tok)
                 req = self.running[uid]
@@ -794,11 +938,22 @@ class ServingSession:
         # chunks fuse into the same forward inside put()
         if put_uids or any(d.pending for d in eng.seqs.values()):
             t0 = self.clock()
+            pend0 = ({u: len(d.pending) for u, d in eng.seqs.items()
+                      if d.pending} if self._tracing else {})
             res = eng.put(put_uids, [[self._pending_tok[u]] for u in put_uids],
                           drain=False)
             for uid in res.admission.admitted:
                 self._pending_tok.pop(uid, None)
             t1 = self.clock()
+            # prefill-chunk edges: which uids advanced their prompt this
+            # forward and by how many tokens (dur is the whole mixed
+            # forward's wall — chunks share the dispatch, annotation only)
+            for u, n0 in pend0.items():
+                d = eng.seqs.get(u)
+                n1 = len(d.pending) if d is not None else 0
+                if n1 < n0:
+                    self._stage(u, "prefill_chunk", t1, dur=t1 - t0,
+                                tokens=n0 - n1)
             # first-token landings this pass: prefill capacity samples.
             # DELIBERATELY enqueue-to-first-token per request, not raw
             # forward throughput: the sample folds in the scheduling delay
@@ -849,6 +1004,8 @@ class ServingSession:
         self.eng.preempt(uid)
         self._count("evicted")
         requeue = self.policy.preempt_policy == "requeue"
+        self._stage(uid, "preempt", now,
+                    policy="requeue" if requeue else "reject")
         events.append(ServeEvent("evict", uid, now,
                                  reason="requeue" if requeue else "reject"))
         if requeue:
@@ -857,11 +1014,15 @@ class ServingSession:
             # its KV before decode can continue. Still in flight: no
             # journal close (a crash here replays it from the watermark)
             req.queued_s = now
+            req.preempted = True
             self.queue.append(req)
             self._count("queued")
         else:
             if self.journal is not None:
                 self.journal.close_request(uid, "evicted")
+            self._observe_stage_times(req)
+            self._trace("serve/close", now,
+                        {"uid": int(uid), "reason": "evicted"})
             events.append(ServeEvent("finish", uid, now, reason="evicted"))
 
     # ------------------------------------------------------------- plumbing
@@ -874,12 +1035,20 @@ class ServingSession:
             # caller sees the tokens (step() returns the events after this),
             # which is what makes crash replay exactly-once
             self.journal.emit(req.uid, toks, len(req.out))
+        self._trace("serve/emit", t, {"uid": int(req.uid), "n": len(toks)})
         if req.first_token_s is None:
             req.first_token_s = t
             d = self.eng.seqs.get(req.uid)
             if d is not None:
                 d.first_token_s = t
             self._observe("Serve/ttft_s", t - req.arrival_s)
+            # prefill edge closes at the first token; cached_prefix_len
+            # makes the prefix-cache saving visible per request
+            self._stage(req.uid, "prefill", t,
+                        dur=max(0.0, t - req.enqueue_s),
+                        cached_prefix_len=req.cached_prefix_len)
+            if req.deadline_s is not None:
+                self._slo_ttft.append((t, t <= req.deadline_s))
         elif req.last_emit_s is not None and toks:
             itl = (t - req.last_emit_s) / len(toks)
             for _ in toks:
@@ -888,14 +1057,31 @@ class ServingSession:
 
     def _finish(self, uid: int, now: float, events: List[ServeEvent],
                 reason: str, flush: bool = True) -> None:
-        self.running.pop(uid, None)
+        req = self.running.pop(uid, None)
         self._pending_tok.pop(uid, None)
         if flush:
             self.eng.flush([uid])
         self._count("completed")
         if self.journal is not None:
             self.journal.close_request(uid, reason)
+        if req is not None:
+            self._observe_stage_times(req)
+        self._trace("serve/close", now, {"uid": int(uid), "reason": reason})
         events.append(ServeEvent("finish", uid, now, reason=reason))
+
+    def _observe_stage_times(self, req: _Request) -> None:
+        """Terminal per-request phase self-times into the Serve/stage.*_s
+        histograms (the streaming twin of the offline join's stage sums;
+        guarded against requeue reorderings where first_token predates the
+        last activation)."""
+        if req.first_token_s is not None \
+                and req.first_token_s >= req.enqueue_s:
+            self._observe("Serve/stage.prefill_s",
+                          req.first_token_s - req.enqueue_s)
+        if req.first_token_s is not None and req.last_emit_s is not None \
+                and req.last_emit_s > req.first_token_s:
+            self._observe("Serve/stage.decode_s",
+                          req.last_emit_s - req.first_token_s)
 
     def _count(self, name: str, n: int = 1) -> None:
         self.counters[name] = self.counters.get(name, 0) + n
@@ -915,12 +1101,17 @@ class ServingSession:
     def _kv_occupancy(self) -> float:
         return kv_pool_stats(self.eng.kv, self.eng.allocator)["occupancy"]
 
-    def _flush_gauges(self) -> None:
+    def _flush_gauges(self, now: Optional[float] = None) -> None:
         if self._metrics is None:
             return
         self._metrics.gauge("Serve/queue_depth").set(len(self.queue))
         self._metrics.gauge("Serve/kv_occupancy").set(self._kv_occupancy())
         self._metrics.gauge("Serve/live_seqs").set(len(self.running))
+        if now is not None:
+            miss, shed, burn = self._slo_snapshot(now)
+            self._metrics.gauge("Serve/slo.ttft_miss_frac").set(miss)
+            self._metrics.gauge("Serve/slo.shed_frac").set(shed)
+            self._metrics.gauge("Serve/slo.burn_rate").set(burn)
         pc = self.eng.prefix_cache
         if pc is not None:
             # the cache keeps lifetime totals; registry counters take the
@@ -990,6 +1181,12 @@ class ServingSession:
             ev += [("Serve/prefix.hit_ratio", float(pc.hit_ratio), step),
                    ("Serve/prefix.pinned_blocks",
                     float(pc.pinned_blocks), step)]
+        if getattr(self, "_slo_ttft", None) is not None \
+                and getattr(self, "clock", None) is not None:
+            miss, shed, burn = self._slo_snapshot(self.clock())
+            ev += [("Serve/slo.ttft_miss_frac", miss, step),
+                   ("Serve/slo.shed_frac", shed, step),
+                   ("Serve/slo.burn_rate", burn, step)]
         if self._metrics is not None:
             for name in SERVE_HISTOGRAMS:
                 hist = self._metrics.histogram(name)

@@ -118,6 +118,26 @@ class RequestJournal:
         self.recorder.record("event", "serve/close",
                              data={"uid": int(uid), "reason": reason})
 
+    def stage(self, uid: int, stage: str, dur: Optional[float] = None,
+              **data: Any) -> None:
+        """``serve/stage`` lifecycle-edge record (request-time attribution:
+        ``monitor/reqtrace.py`` joins these into per-request span trees).
+        Rides the same flushed stream as admit/emit/close — no second
+        transport, and the recorder's wall ``t`` is the one clock base the
+        offline join orders on. ``stage`` must be declared in
+        ``reqtrace.SERVE_STAGES`` (dslint's ``undeclared-stage-name`` rule
+        enforces literals at lint time; this validates dynamic calls).
+        ``uid`` −1 marks session-scope records (decode rounds carry the
+        scheduled uid list in ``data`` instead)."""
+        from ...monitor.reqtrace import check_stage
+
+        check_stage(stage)
+        self.recorder.record(
+            "event", "serve/stage",
+            data={"uid": int(uid), "stage": stage,
+                  **({"dur": float(dur)} if dur is not None else {}),
+                  **data})
+
     # ------------------------------------------------- watchdog sink duties
     def dump(self, reason: str = "manual") -> None:
         """Telemetry-compatible flush hook (the serve watchdog calls
@@ -529,6 +549,14 @@ def serve_worker(spec_path: str) -> int:
         if uid in handled:
             return
         handled.add(uid)
+        sp = r.get("spooled_t")
+        if sp is not None:
+            # replica spool-ingestion edge: how long the request file sat
+            # in the spool before this loop picked it up (wall stamps on
+            # both sides — the router's _spool writes spooled_t)
+            session.note_stage(
+                uid, "spool_wait",
+                dur=max(0.0, time.time() - float(sp)))  # dslint: allow(wall-clock-in-step-path)
         if r.get("replayed"):
             outcomes[uid] = session.replay(
                 uid, r["tokens"], int(r["max_new_tokens"]),
@@ -603,6 +631,7 @@ def serve_worker(spec_path: str) -> int:
             spool_seen["mtime"] = mtime
         return n
 
+    prom_path = os.path.join(journal_dir, "metrics_rank0.prom")
     rounds = 0
     if spool_dir:
         while True:
@@ -611,6 +640,10 @@ def serve_worker(spec_path: str) -> int:
             events = session.step() if not session.idle else []
             rounds += 1
             heartbeat.beat(rounds)
+            if rounds % 512 == 0:
+                # serving-plane textfile export: same atomic-rename
+                # contract as the training side's Telemetry.export_textfile
+                session.export_metrics(prom_path)
             if drain["pending"]:
                 if session.idle:
                     break
@@ -630,6 +663,7 @@ def serve_worker(spec_path: str) -> int:
             heartbeat.beat(rounds)
             if not events:
                 time.sleep(0.001)
+    session.export_metrics(prom_path)
     session.close()
     # the journal (all incarnations) is the delivery record — reconstruct
     # the full per-uid sequences from it so the output survives any number

@@ -54,6 +54,12 @@ from .pod import DURATION_BUCKETS_S, histogram_quantile  # noqa: F401
 # module (same direction as the pod import above: the offline CLIs load
 # THAT file standalone — mfu.py never imports telemetry)
 from .mfu import REGIONS as MFU_REGIONS
+# request-lifecycle stage registry for the Serve/stage.* / Fleet/stage.*
+# families lives in the stdlib-only reqtrace module (same import direction:
+# tools/trace_report.py loads THAT file standalone on jax-less nodes)
+from .reqtrace import (FLEET_STAGES as REQTRACE_FLEET_STAGES,
+                       SERVE_STAGES as REQTRACE_SERVE_STAGES,
+                       STAGE_HISTOGRAMS as REQTRACE_STAGE_HISTOGRAMS)
 
 Event = Tuple[str, Any, int]
 
@@ -200,10 +206,26 @@ EVENT_NAMES = frozenset(
      # static event-name lint resolves every member.
      "Health/loss_z", "Health/grad_norm_z", "Health/nonfinite_count",
      "Health/warns", "Health/skips", "Health/rollbacks", "Health/aborts",
-     "Health/anomaly_streak"}
+     "Health/anomaly_streak",
+     # request-time attribution (monitor/reqtrace.py; docs/observability.md
+     # "request-time attribution"): the admission→first-prefill-dispatch
+     # queue-wait histogram and the sliding-window SLO burn gauges — the
+     # fraction of first tokens missing their per-request TTFT SLA, the
+     # fraction of arrivals shed, and miss_frac/error_budget burn rates.
+     # Per-stage counters/histograms are enumerated from the reqtrace stage
+     # registry below (the MFU-region pattern: a typo'd stage fails dslint's
+     # undeclared-stage-name rule, not strict mode at runtime).
+     "Serve/queue_wait_s",
+     "Serve/slo.ttft_miss_frac", "Serve/slo.shed_frac", "Serve/slo.burn_rate",
+     "Fleet/slo.ttft_miss_frac", "Fleet/slo.shed_frac", "Fleet/slo.burn_rate"}
     | {f"MFU/region.{r}" for r in MFU_REGIONS}  # dslint: allow(undeclared-event-name) registry-enumerated member builder
     | {f"Health/grad_norm.{r}" for r in MFU_REGIONS}  # dslint: allow(undeclared-event-name) registry-enumerated member builder
-    | {f"Serve/{h}/{q}" for h in ("ttft_s", "itl_s",
+    | {f"Serve/stage.{s}" for s in REQTRACE_SERVE_STAGES}  # dslint: allow(undeclared-event-name) registry-enumerated member builder
+    | {f"Fleet/stage.{s}" for s in REQTRACE_FLEET_STAGES}  # dslint: allow(undeclared-event-name) registry-enumerated member builder
+    | {f"Serve/stage.{s}_s" for s in REQTRACE_STAGE_HISTOGRAMS}  # dslint: allow(undeclared-event-name) registry-enumerated member builder
+    | {f"Serve/stage.{s}_s/{q}" for s in REQTRACE_STAGE_HISTOGRAMS  # dslint: allow(undeclared-event-name) registry-enumerated member builder
+       for q in ("p50", "p95", "p99")}
+    | {f"Serve/{h}/{q}" for h in ("ttft_s", "itl_s", "queue_wait_s",
                                   "recovery.time_to_recover_s")
        for q in ("p50", "p95", "p99")}
     | {f"Fleet/{h}/{q}" for h in ("routed_ttft_s",)
@@ -775,6 +797,34 @@ def render_prometheus(snapshot: Dict[str, Any],
     return "\n".join(lines) + "\n"
 
 
+def export_metrics_textfile(path: str, snapshot: Dict[str, Any],
+                            labels: Optional[Dict[str, str]] = None,
+                            extra_counters: Optional[Dict[str, Any]] = None
+                            ) -> str:
+    """Write one registry snapshot as a Prometheus textfile-collector file
+    with the atomic-rename contract (write ``<path>.tmp<pid>``, then
+    ``os.replace`` — a scraper never observes a torn file). The single
+    implementation behind :meth:`Telemetry.export_textfile` (training,
+    rank-labelled) and the serving plane (``serve_worker`` per-replica
+    journals dir, ``FleetRouter`` beside its stream) so both sides share
+    one cumulative-bucket/labeling contract. Failure is a warning, never
+    fatal — export must not kill the workload."""
+    if extra_counters:
+        snapshot = {**snapshot,
+                    "counters": {**snapshot.get("counters", {}),
+                                 **extra_counters}}
+    text = render_prometheus(snapshot, labels=labels)
+    tmp = f"{path}.tmp{os.getpid()}"
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    except OSError as e:  # export failure must never kill the workload
+        logger.warning("textfile export failed: %s", e)
+    return path
+
+
 _anchor_lock = threading.Lock()
 _anchor_counter = 0
 
@@ -1151,21 +1201,11 @@ class Telemetry:
         ``telemetry.textfile.enabled`` is set; safe to call manually."""
         path = path or os.path.join(self.cfg.output_dir,
                                     f"metrics_rank{self.rank}.prom")
-        snap = self.registry.snapshot()
-        snap = {**snap,
-                "counters": {**snap["counters"],
-                             **{f"resilience_{k}": v for k, v in
-                                resilience_counters.snapshot().items()}}}
-        text = render_prometheus(snap, labels={"rank": str(self.rank)})
-        tmp = f"{path}.tmp{os.getpid()}"
-        try:
-            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-            with open(tmp, "w") as f:
-                f.write(text)
-            os.replace(tmp, path)
-        except OSError as e:  # export failure must never kill training
-            logger.warning("textfile export failed: %s", e)
-        return path
+        return export_metrics_textfile(
+            path, self.registry.snapshot(),
+            labels={"rank": str(self.rank)},
+            extra_counters={f"resilience_{k}": v for k, v in
+                            resilience_counters.snapshot().items()})
 
     # ------------------------------------------------------------ reporting
     def periodic_events(self, step: int) -> List[Event]:

@@ -527,6 +527,9 @@ class TestProcessReplicaHealth:
         assert len(rr_names) == 1 and rr_names[0].endswith("_3.json")
         with open(os.path.join(pr.spool_dir, rr_names[0])) as f:
             rec = json.load(f)
+        # spooled_t is the router-side ingestion stamp the worker turns
+        # into the request's spool_wait stage (monitor/reqtrace.py)
+        assert abs(time.time() - rec.pop("spooled_t")) < 60.0
         assert rec == {"uid": 3, "tokens": [1, 2], "max_new_tokens": 4,
                        "tenant": "t", "rate_sla": 0.0}
         assert not [n for n in os.listdir(pr.spool_dir) if ".tmp" in n]

@@ -36,12 +36,46 @@ def lower_is_better(metric: str, unit: str = "") -> bool:
     return unit.lower() in ("s", "ms", "seconds")
 
 
+def _stage_rows(metric: str, detail: Dict[str, Any],
+                out: Dict[str, Dict[str, Any]]) -> None:
+    """Synthesize per-stage TTFT-p95 rows from ``detail.request_waterfall``
+    payloads (per load point), so stage-level latency regressions gate like
+    any other lower-better metric. Partial (mid-sweep flush) lines are
+    skipped — their final aggregate line restates the same sweep."""
+    if detail.get("partial"):
+        return
+    points = []
+    if isinstance(detail.get("point"), dict):
+        points.append(detail["point"])
+    for p in detail.get("load_sweep") or []:
+        if isinstance(p, dict):
+            points.append(p)
+    av = detail.get("availability")
+    if isinstance(av, dict):
+        points.append({**av, "_label": "avail"})
+    for p in points:
+        wf = p.get("request_waterfall")
+        if not isinstance(wf, dict):
+            continue
+        load = p.get("_label", p.get("clients", p.get("requests", "pt")))
+        for stage, qs in (wf.get("ttft_by_stage") or {}).items():
+            v = qs.get("p95") if isinstance(qs, dict) else None
+            if v is None:
+                continue
+            name = f"{metric}.c{load}.stage_{stage}_ttft_p95_s"
+            if name not in out:
+                out[name] = {"metric": name, "value": float(v), "unit": "s",
+                             "detail": {"synthesized_from":
+                                        "request_waterfall"}}
+
+
 def _ingest(rec: Any, out: Dict[str, Dict[str, Any]]) -> None:
     if not isinstance(rec, dict):
         return
     metric = rec.get("metric")
     if isinstance(metric, str) and "value" in rec and metric not in out:
         out[metric] = rec
+        _stage_rows(metric, rec.get("detail") or {}, out)
     # the final aggregate line carries every rung under detail.rungs —
     # recovers rungs whose own line fell off a truncated tail
     for sub in (rec.get("detail") or {}).get("rungs", []) or []:

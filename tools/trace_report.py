@@ -13,6 +13,7 @@ Usage::
     python tools/trace_report.py 'logs/flightrec_rank*.jsonl' --last 30
     python tools/trace_report.py telemetry_logs/ --pod      # pod-scope view
     python tools/trace_report.py fleet_root/ --fleet        # fleet view
+    python tools/trace_report.py fleet_root/ --requests     # request waterfall
 
 Inputs may be directories (their ``flightrec*.jsonl``), glob patterns, or
 explicit files; rank ids are inferred from the ``rank<N>`` filename
@@ -38,6 +39,21 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import pod_report  # noqa: E402
 
 _pod = pod_report.pod
+
+
+def _load_reqtrace():
+    """Load ``monitor/reqtrace.py`` by file path, NOT through the package
+    (same login-node contract as the pod.py loader above: the package
+    __init__ imports jax; reqtrace is deliberately stdlib-only)."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "deepspeedsyclsupport_tpu", "monitor",
+        "reqtrace.py")
+    spec = importlib.util.spec_from_file_location("_dstpu_reqtrace", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 #: A goodput split must account for at least this fraction of wall-clock —
 #: the accounter computes ``other`` as the residual, so anything below this
@@ -409,10 +425,25 @@ def fleet_summary(root: str) -> Optional[str]:
         replayed: set = set()
         closes: Dict[Any, str] = {}
         tokens = 0
+        prefix: Dict[str, Any] = {}
+        recover_hist = None
         for path in files:
             for rec in load_records(path):
                 name = rec.get("name")
                 data = rec.get("data") or {}
+                if rec.get("kind") == "dump":
+                    # the dump marker carries the replica's final registry
+                    # snapshot: per-replica prefix reuse + recovery quantiles
+                    m = (rec.get("data") or {}).get("metrics", {})
+                    for section in ("counters", "gauges"):
+                        for k, v in m.get(section, {}).items():
+                            if k.startswith("Serve/prefix."):  # dslint: allow(undeclared-event-name) read-side filter
+                                prefix[k] = v
+                    h = m.get("histograms", {}).get(
+                        "Serve/recovery.time_to_recover_s")
+                    if h and h.get("count"):
+                        recover_hist = h
+                    continue
                 uid = data.get("uid")
                 if uid is None:
                     continue
@@ -441,6 +472,23 @@ def fleet_summary(root: str) -> Optional[str]:
             f"({len(replayed)} replayed-in), {len(closes)} closed, "
             f"{len(admits) - len(closes)} left in flight here, "
             f"{tokens} token(s); closes: {rtxt}")
+        hits = float(prefix.get("Serve/prefix.hits", 0) or 0)  # dslint: allow(undeclared-event-name) read-side filter
+        misses = float(prefix.get("Serve/prefix.misses", 0) or 0)  # dslint: allow(undeclared-event-name) read-side filter
+        if hits + misses > 0:
+            saved = prefix.get("Serve/prefix.tokens_saved", 0)  # dslint: allow(undeclared-event-name) read-side filter
+            lines.append(
+                f"    prefix reuse: hit ratio "
+                f"{hits / (hits + misses):.3f} ({int(hits)} hit(s) / "
+                f"{int(hits + misses)} lookup(s)), "
+                f"{int(saved or 0)} token(s) of prefill skipped")
+        if recover_hist:
+            qs = {q: _pod.histogram_quantile(
+                tuple(recover_hist["buckets"]), recover_hist["counts"],
+                recover_hist["count"], q) for q in (0.5, 0.95, 0.99)}
+            qtxt = ", ".join(f"p{int(q * 100)}={v:.3f}s"
+                             for q, v in qs.items() if v is not None)
+            lines.append(f"    time_to_recover "
+                         f"({recover_hist['count']} sample(s)): {qtxt}")
         try:
             with open(os.path.join(jdir, "failover_claim.json")) as f:
                 claims += len((json.load(f) or {}).get("uids", {}))
@@ -499,6 +547,115 @@ def fleet_summary(root: str) -> Optional[str]:
     elif claims:
         lines.append(f"  failover: {claims} claimed stream(s) "
                      f"(no router stream found)")
+    return "\n".join(lines)
+
+
+def requests_report(root: str, worst_n: int = 5, window_s: float = 60.0,
+                    budget: float = 0.05) -> Optional[str]:
+    """Request-time attribution: fuse the router stream + per-replica
+    request journals under ``root`` into per-request span trees
+    (``monitor/reqtrace.py``) and render where TTFT and ITL go — per-stage
+    quantiles, tail attribution, reconciliation, SLO burn and worst-request
+    waterfalls. ``root`` may be a fleet root (``replica*/`` + router
+    stream) or a single journal directory."""
+    rt = _load_reqtrace()
+    traces = rt.join_root(root)
+    if not traces:
+        return None
+    att = rt.attribution(traces, worst_n=worst_n, slo_window_s=window_s,
+                         slo_budget=budget)
+
+    def _qline(qs: Dict[str, Any]) -> str:
+        return ", ".join(f"{q}={_fmt_s(v)}" for q, v in qs.items()
+                         if q.startswith("p") and v is not None)
+
+    lines = [f"request-time attribution — {att['requests']} request(s), "
+             f"{att['closed']} closed, {att['edge_sheds']} edge shed(s), "
+             f"{att['failover_spans']} failover span(s)"]
+    if att["multi_close"]:
+        lines.append(f"  WARNING: {att['multi_close']} request(s) closed "
+                     f"more than once — exactly-once is broken")
+    rec = att["reconciliation"]
+    if rec["median_frac"] is not None:
+        flag = "" if (rec["within_5pct_frac"] or 0) >= 0.95 else \
+            "  <-- BELOW CONTRACT (stage sums should cover >=95% of wall)"
+        lines.append(
+            f"  reconciliation: median {100 * rec['median_frac']:.1f}% of "
+            f"wall attributed, min {100 * rec['min_frac']:.1f}%, "
+            f"{100 * rec['within_5pct_frac']:.1f}% of requests within "
+            f"5%{flag}")
+    if att["ttft"].get("p50") is not None:
+        lines.append(f"  TTFT: {_qline(att['ttft'])}")
+    if att["ttft_by_stage"]:
+        lines.append("  TTFT by stage (admit -> first token):")
+        ranked = sorted(att["ttft_by_stage"].items(),
+                        key=lambda kv: -kv[1]["mean_s"])
+        for stage, qs in ranked:
+            if qs["mean_s"] <= 0 and not any(
+                    qs.get(p) for p in ("p50", "p95", "p99")):
+                continue
+            dom = "  <-- dominant" if stage == att["dominant_ttft_stage"] \
+                else ""
+            lines.append(f"    {stage:<14}mean={_fmt_s(qs['mean_s'])}  "
+                         f"{_qline(qs)}{dom}")
+    if att["itl_by_stage"]:
+        itl = {s: qs for s, qs in att["itl_by_stage"].items()
+               if qs["mean_s"] > 0}
+        if itl:
+            lines.append("  ITL by stage (per token past the first):")
+            for stage, qs in sorted(itl.items(),
+                                    key=lambda kv: -kv[1]["mean_s"]):
+                lines.append(f"    {stage:<14}mean={_fmt_s(qs['mean_s'])}  "
+                             f"{_qline(qs)}")
+    tail = att["tail"]
+    if tail:
+        lines.append(f"  tail attribution (slowest {tail['tail_n']} vs "
+                     f"median {tail['median_n']}):")
+        for stage, d in sorted(tail["by_stage"].items(),
+                               key=lambda kv: -kv[1]["growth_s"]):
+            if abs(d["growth_s"]) < 1e-9 and d["tail_s"] <= 0:
+                continue
+            dom = "  <-- tail driver" if stage == tail["dominant_stage"] \
+                else ""
+            lines.append(f"    {stage:<14}median={_fmt_s(d['median_s'])}  "
+                         f"tail={_fmt_s(d['tail_s'])}  "
+                         f"growth={_fmt_s(d['growth_s'])}{dom}")
+    dr = att["decode_rounds"]
+    if dr["fused"] or dr["per_token"]:
+        lines.append(f"  decode rounds: {dr['fused']} fused, "
+                     f"{dr['per_token']} per-token")
+    if att["cached_prefix_tokens_mean"]:
+        lines.append(f"  cached prefix: "
+                     f"{att['cached_prefix_tokens_mean']:.1f} token(s)/request "
+                     f"mean")
+    burn = att["slo_burn"]
+    if burn["windows"]:
+        worst = max(burn["windows"], key=lambda w: w["burn"])
+        lines.append(
+            f"  SLO burn ({burn['window_s']:.0f}s windows, budget "
+            f"{burn['budget']:.0%}): max burn {burn['max_burn']:.2f}x "
+            f"(worst window: {worst['n']} request(s), "
+            f"{100 * worst['miss_frac']:.1f}% missed)"
+            + ("  <-- BUDGET EXHAUSTING" if burn["max_burn"] > 1 else ""))
+    if att["worst"]:
+        lines.append(f"  worst {len(att['worst'])} request(s) by TTFT:")
+        for w in att["worst"]:
+            path = "->".join(w["replica_path"]) or "?"
+            stages = ", ".join(f"{s}={_fmt_s(v)}"
+                               for s, v in sorted(
+                                   w["stages"].items(),
+                                   key=lambda kv: -kv[1]) if v > 0)
+            lines.append(
+                f"    uid {w['uid']}: ttft={_fmt_s(w['ttft_s'])} "
+                f"wall={_fmt_s(w['wall_s']) if w['wall_s'] else '?'} "
+                f"tokens={w['tokens']} replicas={path}"
+                + (f" replays={w['replays']}" if w["replays"] else "")
+                + f" [{w['close_reason'] or 'open'}]")
+            if stages:
+                lines.append(f"      {stages}")
+            if w["unattributed_s"] > 1e-9:
+                lines.append(f"      unattributed="
+                             f"{_fmt_s(w['unattributed_s'])}")
     return "\n".join(lines)
 
 
@@ -595,9 +752,34 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "lifecycle, failover ledger and routed-TTFT "
                          "quantiles from a fleet root directory "
                          "(replica*/ + router.jsonl)")
+    ap.add_argument("--requests", action="store_true",
+                    help="request-time attribution: journal-joined "
+                         "per-request span trees — per-stage TTFT/ITL "
+                         "decomposition, tail attribution, SLO burn and "
+                         "worst-request waterfalls from a fleet root or "
+                         "journal directory")
+    ap.add_argument("--worst", type=int, default=5,
+                    help="worst-request exemplars to show with --requests")
+    ap.add_argument("--slo-window", type=float, default=60.0,
+                    help="SLO burn sliding window seconds (--requests)")
+    ap.add_argument("--slo-budget", type=float, default=0.05,
+                    help="SLO error budget fraction (--requests)")
     args = ap.parse_args(argv)
     if args.pod:
         return pod_report.main([*args.files, "--last", str(args.last)])
+    if args.requests:
+        reports = [requests_report(os.path.expanduser(p),
+                                   worst_n=args.worst,
+                                   window_s=args.slo_window,
+                                   budget=args.slo_budget)
+                   for p in args.files]
+        reports = [r for r in reports if r]
+        if not reports:
+            print("no request traces found in any input directory",
+                  file=sys.stderr)
+            return 2
+        print("\n\n".join(reports))
+        return 0
     if args.fleet:
         reports = [fleet_summary(os.path.expanduser(p)) for p in args.files]
         reports = [r for r in reports if r]
